@@ -1,0 +1,73 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's capability
+surface, built from scratch on JAX/XLA/Pallas/PJRT (see SURVEY.md at the repo root).
+
+Eager mode: Tensor over PJRT buffers + tape autograd over jit-cached per-op executables.
+Graph mode: whole-program XLA via `paddle_tpu.jit.to_static`.
+Distributed: GSPMD over `jax.sharding.Mesh` (dp/mp/pp/sep/sharding/ep axes).
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 semantics to match the reference's default dtypes (indices are
+# int64, paddle.arange of ints is int64). Float ops stay float32/bf16 unless the
+# user asks for float64.
+_jax.config.update("jax_enable_x64", True)
+
+from .framework import dtype as _dtype_mod  # noqa: E402
+from .framework.dtype import (DType, bfloat16, complex64, complex128,  # noqa: E402
+                              float8_e4m3fn, float8_e5m2, float16, float32,
+                              float64, get_default_dtype, int8, int16, int32,
+                              int64, set_default_dtype, uint8)
+from .framework.dtype import bool_ as bool  # noqa: E402
+from .framework.place import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: E402
+                              device_count, get_device, is_compiled_with_cuda,
+                              is_compiled_with_tpu, set_device)
+from .framework.flags import get_flags, set_flags  # noqa: E402
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: E402
+from .core.tensor import Tensor  # noqa: E402
+from .core.autograd import (enable_grad, grad, is_grad_enabled, no_grad,  # noqa: E402
+                            set_grad_enabled)
+from . import ops as _ops  # noqa: E402  (patches Tensor methods)
+from .ops import *  # noqa: F401,F403,E402
+from .ops import cast, matmul, reshape, concat  # noqa: E402
+
+__version__ = "0.1.0"
+
+# Subsystem imports below are added as they land (nn, optimizer, amp, io, jit,
+# static, distributed, vision, hapi ...).
+for _mod in ("nn", "optimizer", "amp", "io", "jit", "static", "metric", "vision",
+             "distributed", "autograd", "hapi", "incubate", "profiler",
+             "distribution", "device", "inference"):
+    try:
+        __import__(f"{__name__}.{_mod}")
+    except ImportError:
+        pass
+
+try:
+    from .framework.io import load, save  # noqa: E402
+except ImportError:
+    pass
+try:
+    from .hapi.model import Model, summary  # noqa: E402
+except ImportError:
+    pass
+
+
+def disable_static(*a, **k):
+    return None
+
+
+def enable_static(*a, **k):
+    from . import static as _s
+
+    return _s.enable_static()
+
+
+def in_dynamic_mode() -> bool:
+    try:
+        from . import static as _s
+
+        return not _s.in_static_mode()
+    except Exception:
+        return True

@@ -1,0 +1,4 @@
+from . import autograd, dispatch
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, run_backward, set_grad_enabled
+from .dispatch import apply, get_op, op_registry, register_op
+from .tensor import Tensor

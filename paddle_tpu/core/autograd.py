@@ -1,0 +1,359 @@
+"""Eager reverse-mode autograd engine.
+
+TPU-native analog of the reference eager engine (`paddle/fluid/eager/`): every eager op that
+requires grad creates an `OpGradNode` (analog of a generated `GradNodeBase` subclass,
+`fluid/eager/grad_node_info.h:197`) wired to its producers by `Edge`s
+(`grad_node_info.h:53`); leaves get an `AccumulationNode`
+(`fluid/eager/accumulation/accumulation_node.h`). `run_backward` is the in-degree-ordered
+queue traversal of `egr::RunBackward` (`fluid/eager/backward.cc:105`).
+
+The mechanism is TPU-first: instead of re-dispatching per-op CUDA grad kernels, each
+OpGradNode holds the XLA-residual-carrying ``vjp_fn`` pytree produced by the jitted forward
+(see core/dispatch.py) and calling it replays a compiled backward.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _state.grad_enabled = v
+
+
+class no_grad:
+    """Context manager + decorator disabling autograd recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __enter__(s):
+            s._prev = is_grad_enabled()
+            _set_grad_enabled(mode)
+            return s
+
+        def __exit__(s, *exc):
+            _set_grad_enabled(s._prev)
+            return False
+
+    return _Ctx()
+
+
+# ---------------------------------------------------------------------------
+# Graph nodes
+# ---------------------------------------------------------------------------
+
+
+class GradNodeBase:
+    """A node in the reverse graph. Outputs are indexed 0..n_outputs-1."""
+
+    __slots__ = ("edges", "n_outputs", "out_avals", "name", "out_hooks", "__weakref__")
+
+    def __init__(self, name: str, n_outputs: int):
+        self.name = name
+        self.n_outputs = n_outputs
+        # edges[i] = (parent_node, parent_out_index) per *input* slot, or None
+        self.edges: List[Optional[Tuple["GradNodeBase", int]]] = []
+        # (shape, np_dtype) per output, for zero-filling missing cotangents
+        self.out_avals: List[Tuple[tuple, np.dtype]] = []
+        self.out_hooks: List[list] = []
+
+    def run(self, cotangents: List[object]) -> List[Optional[object]]:
+        """Consume per-output cotangents, return per-input-slot gradients."""
+        raise NotImplementedError
+
+    def release(self):
+        pass
+
+
+class AccumulationNode(GradNodeBase):
+    """Leaf sink: accumulates the arriving cotangent into ``tensor.grad``."""
+
+    __slots__ = ("_tensor_ref",)
+
+    def __init__(self, tensor):
+        super().__init__("accumulation", 1)
+        self._tensor_ref = weakref.ref(tensor)
+        self.out_hooks = [tensor._hooks]
+
+    def run(self, cotangents):
+        return []
+
+    @property
+    def tensor(self):
+        return self._tensor_ref()
+
+
+class OpGradNode(GradNodeBase):
+    """Backward of one eager op: wraps the compiled vjp pytree from dispatch."""
+
+    __slots__ = ("vjp_fn", "in_mask", "out_is_tuple", "vjp_caller")
+
+    def __init__(self, name, n_outputs, vjp_fn, in_mask, out_is_tuple, vjp_caller):
+        super().__init__(name, n_outputs)
+        self.vjp_fn = vjp_fn
+        self.in_mask = in_mask  # bool per input slot: participates in grad
+        self.out_is_tuple = out_is_tuple
+        self.vjp_caller = vjp_caller
+
+    def run(self, cotangents):
+        import jax
+
+        if self.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through node {self.name} a second time after its "
+                "buffers were freed; call backward(retain_graph=True) the first time.")
+        cts = []
+        for i, ct in enumerate(cotangents):
+            if ct is None:
+                shape, dt = self.out_avals[i]
+                if np.issubdtype(dt, np.inexact):
+                    cts.append(np.zeros(shape, dt))
+                else:
+                    cts.append(np.zeros(shape, jax.dtypes.float0))
+            else:
+                cts.append(ct)
+        ct_tree = tuple(cts) if self.out_is_tuple else cts[0]
+        grads = self.vjp_caller(self.vjp_fn, ct_tree)
+        out: List[Optional[object]] = []
+        for i, g in enumerate(grads):
+            if not self.in_mask[i] or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                out.append(None)
+            else:
+                out.append(g)
+        return out
+
+    def release(self):
+        self.vjp_fn = None
+
+
+# ---------------------------------------------------------------------------
+# Backward traversal (egr::RunBackward analog)
+# ---------------------------------------------------------------------------
+
+
+def _add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a + b
+
+
+def _discover(seed_nodes):
+    """BFS over ancestors; return reachable set + per-node pending contribution count."""
+    reachable = set()
+    q = deque(seed_nodes)
+    reachable.update(seed_nodes)
+    pending: Dict[GradNodeBase, int] = {}
+    while q:
+        node = q.popleft()
+        for edge in node.edges:
+            if edge is None:
+                continue
+            parent, _ = edge
+            pending[parent] = pending.get(parent, 0) + 1
+            if parent not in reachable:
+                reachable.add(parent)
+                q.append(parent)
+    return reachable, pending
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle Tensor.backward() entry (reference: fluid/eager/backward.cc:105)."""
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    grads_by_node = _seed_cotangents(tensors, grad_tensors)
+    if not grads_by_node:
+        return
+    captured = _traverse(grads_by_node, retain_graph=retain_graph)
+    # write captured leaf gradients into .grad
+    for node, ct in captured.items():
+        if isinstance(node, AccumulationNode) and ct[0] is not None:
+            t = node.tensor
+            if t is not None:
+                _accumulate_into_grad(t, ct[0])
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         allow_unused=False, no_grad_vars=None):
+    """paddle.grad — compute grads of outputs w.r.t. inputs without touching .grad."""
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported by the eager tape yet; "
+            "use paddle_tpu.incubate.autograd or graph mode (jax.grad composition).")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    retain = True if retain_graph is None else retain_graph
+    # map each input tensor to its (node, index) pair
+    targets = {}
+    for idx, t in enumerate(inputs):
+        pair = _pair_of(t)
+        if pair is None:
+            if not allow_unused:
+                raise RuntimeError(f"input {idx} does not require grad")
+            continue
+        targets.setdefault(pair, []).append(idx)
+
+    grads_by_node = _seed_cotangents(outputs, grad_outputs)
+    captured = _traverse(grads_by_node, retain_graph=retain,
+                         capture_pairs=set(targets.keys()))
+    results = [None] * len(inputs)
+    for (node, oidx), idxs in targets.items():
+        cts = captured.get(node)
+        g = cts[oidx] if cts is not None else None
+        for i in idxs:
+            if g is not None:
+                results[i] = Tensor(g, stop_gradient=True)
+            elif not allow_unused:
+                raise RuntimeError(f"gradient for input {i} is unused; "
+                                   "pass allow_unused=True to get None")
+    return results
+
+
+def _pair_of(t):
+    if t._grad_node is not None:
+        return (t._grad_node, t._out_index)
+    if t.stop_gradient:
+        return None
+    return (t._ensure_accum_node(), 0)
+
+
+def _seed_cotangents(tensors, grad_tensors):
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    grads_by_node: Dict[GradNodeBase, List[Optional[object]]] = {}
+    for t, g in zip(tensors, grad_tensors):
+        pair = _pair_of(t)
+        if pair is None:
+            continue
+        node, idx = pair
+        if g is None:
+            # paddle fills the seed gradient with ones for any shape
+            # (fluid/eager/backward.cc RunBackward fill_one path)
+            ct = jnp.ones_like(t._data)
+        else:
+            ct = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        lst = grads_by_node.setdefault(node, [None] * node.n_outputs)
+        lst[idx] = _add(lst[idx], ct)
+    return grads_by_node
+
+
+def _apply_hooks(node, cts):
+    from .tensor import Tensor
+
+    if not any(node.out_hooks):
+        return cts
+    new = list(cts)
+    for i, hooks in enumerate(node.out_hooks):
+        if not hooks or new[i] is None:
+            continue
+        g = Tensor(new[i], stop_gradient=True)
+        for h in list(hooks):
+            r = h(g)
+            if r is not None:
+                g = r if isinstance(r, Tensor) else Tensor(r, stop_gradient=True)
+        new[i] = g._data
+    return new
+
+
+def _traverse(grads_by_node, retain_graph, capture_pairs=None):
+    """Kahn's algorithm over the reverse graph; returns node -> final cotangent list."""
+    reachable, pending = _discover(list(grads_by_node.keys()))
+    acc: Dict[GradNodeBase, List[Optional[object]]] = dict(grads_by_node)
+    captured: Dict[GradNodeBase, List[Optional[object]]] = {}
+    ready = deque(n for n in grads_by_node if pending.get(n, 0) == 0)
+    waiting = {n: c for n, c in pending.items()}
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if node in processed:
+            continue
+        processed.add(node)
+        cts = acc.pop(node, [None] * node.n_outputs)
+        cts = _apply_hooks(node, cts)
+        if isinstance(node, AccumulationNode) or (
+                capture_pairs is not None and any(
+                    (node, i) in capture_pairs for i in range(node.n_outputs))):
+            captured[node] = cts
+        in_grads = node.run(cts)
+        if not retain_graph:
+            node.release()
+        for slot, g in enumerate(in_grads):
+            edge = node.edges[slot] if slot < len(node.edges) else None
+            if edge is None:
+                continue
+            parent, pidx = edge
+            lst = acc.setdefault(parent, [None] * parent.n_outputs)
+            if g is not None:
+                lst[pidx] = _add(lst[pidx], g)
+            if parent in waiting:
+                waiting[parent] -= 1
+                if waiting[parent] == 0:
+                    ready.append(parent)
+    return captured
+
+
+def _accumulate_into_grad(t, ct):
+    from .tensor import Tensor
+
+    if t.grad is None:
+        t._grad = Tensor(ct, stop_gradient=True)
+    else:
+        t._grad = Tensor(t._grad._data + ct, stop_gradient=True)

@@ -1,0 +1,296 @@
+"""Eager op dispatch with per-op executable caching.
+
+TPU-native analog of the reference dispatch path (§3.1 of SURVEY.md): Python op →
+generated C binding → ad_func → kernel selection (`phi/core/kernel_factory.cc:270`) →
+CUDA kernel launch. On TPU the "kernel" is an XLA executable, so dispatch is a cache
+lookup ``(op, static attrs, input shapes/dtypes, grad mask) -> compiled callable``; a miss
+traces the op's JAX function and compiles it once (SURVEY.md §7.2 M1).
+
+When grad is required the cached callable is ``jit(lambda *xs: jax.vjp(fn, *xs))`` — one
+compiled program that returns both outputs and the residual-carrying ``vjp_fn`` pytree,
+which the autograd node replays later (the analog of the generated GradNode capturing
+TensorWrappers, `fluid/eager/eager_gen.py:1127`).
+
+Inside an outer trace (graph mode / jax transforms) dispatch degrades to a plain function
+call on tracers with no tape recording, so the same eager API is traceable by `to_static`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import flags
+from . import autograd
+
+_OP_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """One operator: a pure JAX function ``fn(*arrays, **attrs)``.
+
+    Analog of one entry in the reference's `phi/ops/yaml/ops.yaml` — name, callable
+    kernel, and autodiff participation. ``multi_out`` marks tuple-returning ops.
+    """
+
+    __slots__ = ("name", "fn", "multi_out")
+
+    def __init__(self, name: str, fn: Callable, multi_out: bool = False):
+        self.name = name
+        self.fn = fn
+        self.multi_out = multi_out
+
+
+def register_op(name: str, fn: Callable = None, *, multi_out: bool = False):
+    """Register an op. Usable as decorator or direct call."""
+
+    def deco(f):
+        _OP_REGISTRY[name] = OpDef(name, f, multi_out=multi_out)
+        return f
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return _OP_REGISTRY[name]
+
+
+def op_registry() -> Dict[str, OpDef]:
+    return _OP_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Executable caches
+# ---------------------------------------------------------------------------
+
+_fwd_cache: Dict[tuple, Callable] = {}
+_fwd_vjp_cache: Dict[tuple, Callable] = {}
+
+_compile_count = 0
+
+
+def cache_stats():
+    return {"fwd": len(_fwd_cache), "fwd_vjp": len(_fwd_vjp_cache),
+            "compiles": _compile_count}
+
+
+def clear_caches():
+    _fwd_cache.clear()
+    _fwd_vjp_cache.clear()
+
+
+def _canon_attr(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon_attr(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canon_attr(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return ("__np__", v.tobytes(), v.shape, str(v.dtype))
+    return v
+
+
+def _attr_key(attrs: dict) -> tuple:
+    return tuple(sorted((k, _canon_attr(v)) for k, v in attrs.items()))
+
+
+def _aval_key(arrays) -> tuple:
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+        else:
+            out.append((tuple(a.shape), str(a.dtype)))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=1)
+def _jax():
+    import jax
+
+    return jax
+
+
+def _is_tracer(x) -> bool:
+    jax = _jax()
+    return isinstance(x, jax.core.Tracer)
+
+
+def _log_compile(kind, name, key):
+    global _compile_count
+    _compile_count += 1
+    if flags.flag_value("log_compiles"):
+        print(f"[paddle_tpu] compile {kind} op={name}")
+
+
+def _evict(cache: dict):
+    """Bound cache size to FLAGS_eager_cache_size (FIFO eviction)."""
+    limit = flags.flag_value("eager_cache_size")
+    while len(cache) >= limit > 0:
+        cache.pop(next(iter(cache)))
+
+
+def _get_fwd(op: OpDef, attrs: dict, arrays) -> Callable:
+    jax = _jax()
+    key = (op.name, _attr_key(attrs), _aval_key(arrays))
+    fn = _fwd_cache.get(key)
+    if fn is None:
+        _evict(_fwd_cache)
+        _log_compile("fwd", op.name, key)
+        base = op.fn
+        if attrs:
+            base = functools.partial(base, **attrs)
+        fn = jax.jit(base)
+        _fwd_cache[key] = fn
+    return fn
+
+
+def _get_fwd_vjp(op: OpDef, attrs: dict, arrays, mask) -> Callable:
+    jax = _jax()
+    key = (op.name, _attr_key(attrs), _aval_key(arrays), mask)
+    fn = _fwd_vjp_cache.get(key)
+    if fn is None:
+        _evict(_fwd_vjp_cache)
+        _log_compile("fwd_vjp", op.name, key)
+        base = op.fn
+        if attrs:
+            base = functools.partial(base, **attrs)
+
+        def fwd(*arrays, _base=base, _mask=mask):
+            # stop_gradient on inputs that don't require grad so the vjp does
+            # no wasted transpose work for them.
+            prims = [a if m else jax.lax.stop_gradient(a)
+                     for a, m in zip(arrays, _mask)]
+            out, vjp_fn = jax.vjp(lambda *xs: _base(*xs), *prims)
+            return out, vjp_fn
+
+        fn = jax.jit(fwd)
+        _fwd_vjp_cache[key] = fn
+    return fn
+
+
+@functools.lru_cache(maxsize=1)
+def _vjp_caller():
+    jax = _jax()
+
+    jitted = jax.jit(lambda vf, ct: vf(ct))
+
+    def call(vjp_fn, ct):
+        try:
+            return jitted(vjp_fn, ct)
+        except Exception:
+            return vjp_fn(ct)
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# The eager entry point
+# ---------------------------------------------------------------------------
+
+
+def _differentiable(a) -> bool:
+    return a is not None and np.issubdtype(np.dtype(a.dtype), np.inexact)
+
+
+def apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
+    """Run one op on Tensor inputs; returns Tensor or list of Tensors.
+
+    The eager hot loop (§3.1 steps 2-7 of SURVEY.md collapsed into one cache hit).
+    """
+    from .tensor import Tensor
+
+    op = _OP_REGISTRY[op_name]
+    attrs = attrs or {}
+    arrays = [t._data if isinstance(t, Tensor) else t for t in tensor_inputs]
+
+    # Graph-capture path: inside jax tracing there is no tape; call through.
+    if any(_is_tracer(a) for a in arrays if a is not None):
+        out = op.fn(*arrays, **attrs)
+        sg = not (autograd.is_grad_enabled() and any(
+            isinstance(t, Tensor) and not t.stop_gradient for t in tensor_inputs))
+        return _wrap_traced(op, out, sg)
+
+    requires = autograd.is_grad_enabled() and any(
+        isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data)
+        for t in tensor_inputs)
+
+    if not requires:
+        fn = _get_fwd(op, attrs, arrays)
+        out = fn(*arrays)
+        return _wrap(op, out, stop_gradient=True)
+
+    mask = tuple(
+        isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data)
+        for t in tensor_inputs)
+    fn = _get_fwd_vjp(op, attrs, arrays, mask)
+    out, vjp_fn = fn(*arrays)
+
+    out_is_tuple = isinstance(out, (tuple, list))
+    outs = list(out) if out_is_tuple else [out]
+
+    node = autograd.OpGradNode(op.name, len(outs), vjp_fn, mask, out_is_tuple,
+                               _vjp_caller())
+    node.out_avals = [(tuple(o.shape), np.dtype(o.dtype)) for o in outs]
+    for t in tensor_inputs:
+        if isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data):
+            if t._grad_node is not None:
+                node.edges.append((t._grad_node, t._out_index))
+            else:
+                node.edges.append((t._ensure_accum_node(), 0))
+        else:
+            node.edges.append(None)
+
+    results = []
+    for i, o in enumerate(outs):
+        sg = not _differentiable(o)
+        t = Tensor(o, stop_gradient=sg)
+        if not sg:
+            t._grad_node = node
+            t._out_index = i
+        node.out_hooks.append(t._hooks)
+        results.append(t)
+
+    _maybe_check_nan_inf(op.name, results)
+    if not out_is_tuple:
+        return results[0]
+    return results
+
+
+def _wrap(op, out, stop_gradient):
+    from .tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        res = [Tensor(o, stop_gradient=True) for o in out]
+        _maybe_check_nan_inf(op.name, res)
+        return res
+    t = Tensor(out, stop_gradient=True)
+    _maybe_check_nan_inf(op.name, [t])
+    return t
+
+
+def _wrap_traced(op, out, stop_gradient):
+    from .tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        return [Tensor(o, stop_gradient=stop_gradient) for o in out]
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def _maybe_check_nan_inf(name, tensors):
+    """FLAGS_check_nan_inf analog (`fluid/eager/nan_inf_utils.h:38`)."""
+    if not flags.flag_value("check_nan_inf"):
+        return
+    import jax.numpy as jnp
+
+    for t in tensors:
+        d = t._data
+        if np.issubdtype(np.dtype(d.dtype), np.inexact):
+            bad = bool(jnp.logical_not(jnp.isfinite(d)).any())
+            if bad:
+                msg = f"Op {name} produced NaN/Inf in output {t.shape}"
+                if flags.flag_value("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print("[paddle_tpu][nan_inf]", msg)

@@ -1,0 +1,8 @@
+from . import dtype, flags, place, random
+from .dtype import (DType, bfloat16, bool_, complex64, complex128, convert_dtype,
+                    float8_e4m3fn, float8_e5m2, float16, float32, float64,
+                    get_default_dtype, int8, int16, int32, int64,
+                    set_default_dtype, uint8)
+from .place import (CPUPlace, CUDAPlace, Place, TPUPlace, device_count,
+                    get_device, set_device)
+from .random import get_rng_state, seed, set_rng_state

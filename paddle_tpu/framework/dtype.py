@@ -1,0 +1,152 @@
+"""Dtype system.
+
+TPU-native analog of the reference dtype enum (`paddle/phi/common/data_type.h`) exposed in
+Python as `paddle.float32`-style singletons. Here dtypes are thin wrappers over numpy/jax
+dtypes so they flow straight into XLA without conversion tables.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy gives us bfloat16; fall back to ml_dtypes
+    import jax.numpy as jnp
+
+    _bfloat16 = jnp.bfloat16
+    _float8_e4m3fn = jnp.float8_e4m3fn
+    _float8_e5m2 = jnp.float8_e5m2
+except Exception:  # pragma: no cover
+    import ml_dtypes
+
+    _bfloat16 = ml_dtypes.bfloat16
+    _float8_e4m3fn = ml_dtypes.float8_e4m3fn
+    _float8_e5m2 = ml_dtypes.float8_e5m2
+
+
+class DType:
+    """A framework dtype: hashable singleton comparable to numpy dtypes and strings."""
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64",
+                             "float8_e4m3fn", "float8_e5m2", "complex64", "complex128")
+
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("int8", "int16", "int32", "int64", "uint8", "uint16",
+                             "uint32", "uint64")
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+uint16 = DType("uint16", np.uint16)
+uint32 = DType("uint32", np.uint32)
+uint64 = DType("uint64", np.uint64)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+float8_e4m3fn = DType("float8_e4m3fn", _float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", _float8_e5m2)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "uint16": uint16, "uint32": uint32,
+    "uint64": uint64, "int8": int8, "int16": int16, "int32": int32,
+    "int64": int64, "float16": float16, "half": float16, "bfloat16": bfloat16,
+    "bf16": bfloat16, "float32": float32, "float": float32, "fp32": float32,
+    "float64": float64, "double": float64, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2, "complex64": complex64, "complex128": complex128,
+}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / python type / DType to a DType singleton."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return float32
+    if dtype is complex:
+        return complex64
+    npd = np.dtype(dtype)
+    name = npd.name
+    if name == "bfloat16" or npd == np.dtype(_bfloat16):
+        return bfloat16
+    if name in _ALIASES:
+        return _ALIASES[name]
+    raise ValueError(f"Unsupported dtype: {dtype!r}")
+
+
+def to_np(dtype) -> np.dtype:
+    return convert_dtype(dtype).np_dtype
+
+
+# paddle-style default dtype state (reference: python/paddle/base/framework.py
+# set_default_dtype/get_default_dtype)
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports float types, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+def promote_types(a: DType, b: DType) -> DType:
+    """Type promotion following jax's lattice (weak types not modeled)."""
+    import jax.numpy as jnp
+
+    return convert_dtype(jnp.promote_types(a.np_dtype, b.np_dtype))

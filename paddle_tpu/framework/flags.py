@@ -1,0 +1,73 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's exported-flag system (`paddle/common/flags.h:284`,
+definitions in `paddle/common/flags.cc`): a typed registry, env-var initialization
+(``FLAGS_name=value``), and `set_flags`/`get_flags` exposed at package level.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help")
+
+    def __init__(self, name, default, help_=""):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help_
+        env = os.environ.get(f"FLAGS_{name}")
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, s: str):
+        if self.type is bool:
+            return s.lower() in ("1", "true", "yes", "on")
+        return self.type(s)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    if name.startswith("FLAGS_"):
+        name = name[len("FLAGS_"):]
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, help_)
+    return _REGISTRY[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            define_flag(key, v)
+        else:
+            f = _REGISTRY[key]
+            f.value = f.type(v) if not isinstance(v, f.type) else v
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise KeyError(f"flag {k} is not registered")
+        out[k] = _REGISTRY[key].value
+    return out
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+# Core flags (subset mirroring paddle/common/flags.cc).
+define_flag("check_nan_inf", False, "run nan/inf checks after every eager op")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >=1: log only")
+define_flag("eager_cache_size", 4096, "max cached per-op executables")
+define_flag("benchmark", False, "synchronize after every op (timing mode)")
+define_flag("use_bf16_matmul", False, "force bf16 accumulate-f32 matmuls in eager mode")
+define_flag("log_compiles", False, "log every XLA compilation triggered by eager dispatch")

@@ -1,0 +1,118 @@
+"""Device identity (Place) over JAX devices.
+
+TPU-native analog of `paddle/phi/common/place.h` — instead of an AllocationType enum plus
+device id, a Place wraps a `jax.Device`. `TPUPlace(i)`/`CPUPlace()` mirror the reference's
+`GPUPlace(i)`/`CPUPlace()` API surface.
+"""
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type in ("tpu", "axon")
+
+    @property
+    def jax_device(self):
+        import jax
+
+        devs = [d for d in jax.devices() if _platform_matches(d, self.device_type)]
+        if not devs:
+            # fall back to host cpu devices
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def _platform_matches(dev, device_type: str) -> bool:
+    plat = dev.platform.lower()
+    if device_type in ("tpu", "axon"):
+        return plat in ("tpu", "axon")
+    return plat == device_type
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+# CUDAPlace is accepted for API compatibility and maps to the accelerator.
+class CUDAPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _default_accelerator_type() -> str:
+    import jax
+
+    try:
+        plat = jax.devices()[0].platform.lower()
+    except Exception:
+        return "cpu"
+    return "tpu" if plat in ("tpu", "axon") else plat
+
+
+_expected_place = None
+
+
+def get_device() -> str:
+    p = _get_expected_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def set_device(device: str) -> Place:
+    global _expected_place
+    if ":" in device:
+        dtype_, did = device.split(":")
+        did = int(did)
+    else:
+        dtype_, did = device, 0
+    if dtype_ in ("gpu", "cuda", "xpu"):
+        dtype_ = _default_accelerator_type()
+    _expected_place = Place(dtype_, did)
+    return _expected_place
+
+
+def _get_expected_place() -> Place:
+    if _expected_place is not None:
+        return _expected_place
+    return Place(_default_accelerator_type(), 0)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _default_accelerator_type() == "tpu"
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,76 @@
+"""RNG state management.
+
+TPU-native analog of the reference generator (`paddle/phi/core/generator.h`): a per-device
+stateful seed that hands out fresh `jax.random` keys. Eager ops draw subkeys from the global
+generator; compiled/functional paths thread keys explicitly (JAX-idiomatic).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class Generator:
+    """Stateful splitting RNG over a jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        import jax
+
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(self._seed)
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh PRNG key; advances internal state."""
+        import jax
+
+        with self._lock:
+            # fold_in with a counter rather than split() so state is O(1) and
+            # reproducible given (seed, counter) — mirrors the reference's
+            # (seed, offset) random state pair (phi/core/generator.h).
+            self._counter += 1
+            return jax.random.fold_in(self._key, self._counter)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        import jax
+
+        self._seed, self._counter = int(state[0]), int(state[1])
+        self._key = jax.random.key(self._seed)
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reseed the global default generator."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(states):
+    _default_generator.set_state(states[0])
+
+
+def next_key():
+    return _default_generator.next_key()

@@ -1,0 +1,187 @@
+"""Shared op-wrapper machinery: scalar handling, paddle-style type promotion."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def as_tensor(x, dtype=None) -> Tensor:
+    import jax.numpy as jnp
+
+    if isinstance(x, Tensor):
+        if dtype is not None and x.dtype != dtype_mod.convert_dtype(dtype):
+            from . import manipulation
+
+            return manipulation.cast(x, dtype)
+        return x
+    npdtype = None
+    if dtype is not None:
+        npdtype = dtype_mod.to_np(dtype)
+    elif isinstance(x, bool):
+        npdtype = np.bool_
+    elif isinstance(x, int):
+        npdtype = np.int64
+    elif isinstance(x, float):
+        npdtype = dtype_mod.get_default_dtype().np_dtype
+    return Tensor(jnp.asarray(x, dtype=npdtype), stop_gradient=True)
+
+
+def result_dtype(xd: np.dtype, yd: np.dtype) -> np.dtype:
+    """Paddle-style promotion: float beats int; no silent widening to float64."""
+    xd, yd = np.dtype(xd), np.dtype(yd)
+    if xd == yd:
+        return xd
+    xf, yf = np.issubdtype(xd, np.inexact), np.issubdtype(yd, np.inexact)
+    if xf and yf:
+        # bf16 x f16 -> f32; otherwise numpy promotion (f16xf32->f32 etc.)
+        names = {xd.name, yd.name}
+        if names == {"bfloat16", "float16"}:
+            return np.dtype(np.float32)
+        try:
+            return np.promote_types(xd, yd)
+        except TypeError:
+            return np.dtype(np.float32)
+    if xf:
+        return xd
+    if yf:
+        return yd
+    return np.promote_types(xd, yd)
+
+
+def prep_binary(x, y):
+    """Normalize the (tensor|scalar, tensor|scalar) pair to two same-dtype Tensors."""
+    if not isinstance(x, Tensor) and not isinstance(y, Tensor):
+        x = as_tensor(x)
+        y = as_tensor(y)
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        y = _scalar_like(y, x)
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        x = _scalar_like(x, y)
+    rd = result_dtype(x._data.dtype, y._data.dtype)
+    if np.dtype(x._data.dtype) != rd:
+        from . import manipulation
+
+        x = manipulation.cast(x, rd)
+    if np.dtype(y._data.dtype) != rd:
+        from . import manipulation
+
+        y = manipulation.cast(y, rd)
+    return x, y
+
+
+def _scalar_like(scalar, t: Tensor) -> Tensor:
+    import jax.numpy as jnp
+
+    td = np.dtype(t._data.dtype)
+    if isinstance(scalar, (bool, np.bool_)):
+        d = np.bool_ if td == np.bool_ else td
+    elif isinstance(scalar, (float, np.floating)) and not np.issubdtype(td, np.inexact):
+        d = dtype_mod.get_default_dtype().np_dtype
+    elif isinstance(scalar, complex):
+        d = np.complex64
+    elif isinstance(scalar, (np.ndarray, list, tuple)):
+        return as_tensor(scalar)
+    else:
+        d = td
+    return Tensor(jnp.asarray(scalar, dtype=d), stop_gradient=True)
+
+
+def make_unary(op_name: str, jfn):
+    dispatch.register_op(op_name, lambda x: jfn(x))
+
+    def api(x, name=None):
+        return dispatch.apply(op_name, [as_tensor(x)])
+
+    api.__name__ = op_name
+    return api
+
+
+def make_float_unary(op_name: str, jfn):
+    """Unary op that casts integer input to default float first (paddle semantics)."""
+    dispatch.register_op(op_name, lambda x: jfn(x))
+
+    def api(x, name=None):
+        x = as_tensor(x)
+        if not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+            from . import manipulation
+
+            x = manipulation.cast(x, dtype_mod.get_default_dtype())
+        return dispatch.apply(op_name, [x])
+
+    api.__name__ = op_name
+    return api
+
+
+def make_binary(op_name: str, jfn, float_only=False):
+    dispatch.register_op(op_name, lambda x, y: jfn(x, y))
+
+    def api(x, y, name=None):
+        x, y = prep_binary(x, y)
+        if float_only and not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+            from . import manipulation
+
+            fd = dtype_mod.get_default_dtype()
+            x, y = manipulation.cast(x, fd), manipulation.cast(y, fd)
+        return dispatch.apply(op_name, [x, y])
+
+    api.__name__ = op_name
+    return api
+
+
+def make_compare(op_name: str, jfn):
+    dispatch.register_op(op_name, lambda x, y: jfn(x, y))
+
+    def api(x, y, name=None):
+        x, y = prep_binary(x, y)
+        return dispatch.apply(op_name, [x, y])
+
+    api.__name__ = op_name
+    return api
+
+
+def inplace_rebind(x, out):
+    """Rebind x to out's buffer/graph (paddle inplace-op semantics).
+
+    Raises like the reference (`fluid/eager/utils.cc CheckInplace`) when the target
+    is a leaf that requires grad and the op recorded a grad node — otherwise a
+    manual `param.add_(...)` outside no_grad silently grows the tape.
+    """
+    if (isinstance(x, Tensor) and x._grad_node is None and not x.stop_gradient
+            and out._grad_node is not None):
+        raise RuntimeError(
+            "Leaf Tensor that doesn't stop gradient can't use inplace strategy; "
+            "wrap the update in paddle.no_grad()")
+    x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
+    return x
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) + ndim if int(a) < 0 else int(a) for a in axis)
+    axis = int(axis)
+    return axis + ndim if axis < 0 else axis
+
+
+def shape_to_tuple(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)

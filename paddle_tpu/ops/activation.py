@@ -1,0 +1,222 @@
+"""Activation ops (reference: paddle/phi/kernels/activation_kernel.*,
+python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, make_float_unary, normalize_axis
+
+relu = make_float_unary("relu", jax.nn.relu)
+relu6 = make_float_unary("relu6", jax.nn.relu6)
+sigmoid = make_float_unary("sigmoid_act", jax.nn.sigmoid)
+tanh = make_float_unary("tanh_act", jnp.tanh)
+silu = make_float_unary("silu", jax.nn.silu)
+swish = silu
+mish = make_float_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = make_float_unary("softsign", jax.nn.soft_sign)
+tanhshrink = make_float_unary("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = make_float_unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+dispatch.register_op("gelu", lambda x, *, approximate: jax.nn.gelu(x, approximate=approximate))
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch.apply("gelu", [as_tensor(x)], {"approximate": bool(approximate)})
+
+
+dispatch.register_op("leaky_relu", lambda x, *, slope: jax.nn.leaky_relu(x, negative_slope=slope))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch.apply("leaky_relu", [as_tensor(x)], {"slope": float(negative_slope)})
+
+
+dispatch.register_op("elu", lambda x, *, alpha: jax.nn.elu(x, alpha=alpha))
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch.apply("elu", [as_tensor(x)], {"alpha": float(alpha)})
+
+
+dispatch.register_op("celu", lambda x, *, alpha: jax.nn.celu(x, alpha=alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch.apply("celu", [as_tensor(x)], {"alpha": float(alpha)})
+
+
+dispatch.register_op("selu", lambda x, *, scale, alpha: scale * jnp.where(
+    x > 0, x, alpha * (jnp.exp(x) - 1)))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch.apply("selu", [as_tensor(x)], {"scale": float(scale), "alpha": float(alpha)})
+
+
+dispatch.register_op("hardswish", jax.nn.hard_swish)
+
+
+def hardswish(x, name=None):
+    return dispatch.apply("hardswish", [as_tensor(x)])
+
+
+dispatch.register_op("hardsigmoid", lambda x, *, slope, offset: jnp.clip(
+    slope * x + offset, 0.0, 1.0))
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return dispatch.apply("hardsigmoid", [as_tensor(x)],
+                          {"slope": float(slope), "offset": float(offset)})
+
+
+dispatch.register_op("hardtanh", lambda x, *, mn, mx: jnp.clip(x, mn, mx))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch.apply("hardtanh", [as_tensor(x)], {"mn": float(min), "mx": float(max)})
+
+
+dispatch.register_op("hardshrink", lambda x, *, threshold: jnp.where(
+    jnp.abs(x) > threshold, x, 0.0))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch.apply("hardshrink", [as_tensor(x)], {"threshold": float(threshold)})
+
+
+dispatch.register_op("softshrink", lambda x, *, threshold: jnp.where(
+    x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch.apply("softshrink", [as_tensor(x)], {"threshold": float(threshold)})
+
+
+dispatch.register_op("softplus", lambda x, *, beta, threshold: jnp.where(
+    beta * x > threshold, x, jax.nn.softplus(beta * x) / beta))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch.apply("softplus", [as_tensor(x)],
+                          {"beta": float(beta), "threshold": float(threshold)})
+
+
+dispatch.register_op("thresholded_relu", lambda x, *, threshold, value: jnp.where(
+    x > threshold, x, value))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch.apply("thresholded_relu", [as_tensor(x)],
+                          {"threshold": float(threshold), "value": float(value)})
+
+
+dispatch.register_op("softmax", lambda x, *, axis: jax.nn.softmax(x, axis=axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    elif not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        from .manipulation import cast
+        from ..framework import dtype as dtype_mod
+
+        x = cast(x, dtype_mod.get_default_dtype())
+    return dispatch.apply("softmax", [x], {"axis": int(axis)})
+
+
+dispatch.register_op("log_softmax", lambda x, *, axis: jax.nn.log_softmax(x, axis=axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    elif not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        from .manipulation import cast
+        from ..framework import dtype as dtype_mod
+
+        x = cast(x, dtype_mod.get_default_dtype())
+    return dispatch.apply("log_softmax", [x], {"axis": int(axis)})
+
+
+dispatch.register_op("prelu_op", lambda x, w: jnp.where(x >= 0, x, w * x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = as_tensor(x), as_tensor(weight)
+    if w.size > 1:
+        # broadcast weight along channel dim
+        shape = [1] * x.ndim
+        ch = 1 if data_format == "NCHW" else x.ndim - 1
+        shape[ch] = w.size
+        from .manipulation import reshape
+
+        w = reshape(w, shape)
+    return dispatch.apply("prelu_op", [x, w])
+
+
+dispatch.register_op("rrelu_eval", lambda x, *, lower, upper: jnp.where(
+    x >= 0, x, (lower + upper) / 2 * x))
+
+
+def rrelu(x, lower=1 / 8, upper=1 / 3, training=False, name=None):
+    x = as_tensor(x)
+    if training:
+        from ..framework import random as random_mod
+
+        if "rrelu_train" not in dispatch.op_registry():
+            dispatch.register_op("rrelu_train", lambda key, x, *, lower, upper: jnp.where(
+                x >= 0, x,
+                jax.random.uniform(key, x.shape, x.dtype, lower, upper) * x))
+        return dispatch.apply("rrelu_train", [random_mod.next_key(), x],
+                              {"lower": float(lower), "upper": float(upper)})
+    return dispatch.apply("rrelu_eval", [x], {"lower": float(lower), "upper": float(upper)})
+
+
+dispatch.register_op("glu_op", lambda x, *, axis: jax.nn.glu(x, axis=axis))
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch.apply("glu_op", [as_tensor(x)], {"axis": int(axis)})
+
+
+dispatch.register_op("swiglu", lambda x, y: jax.nn.silu(x) * y)
+dispatch.register_op("swiglu_packed", lambda x: (lambda a, b: jax.nn.silu(a) * b)(
+    *jnp.split(x, 2, axis=-1)))
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (reference: python/paddle/incubate/nn/functional/swiglu.py)."""
+    if y is None:
+        return dispatch.apply("swiglu_packed", [as_tensor(x)])
+    return dispatch.apply("swiglu", [as_tensor(x), as_tensor(y)])
+
+
+dispatch.register_op("maxout_op", lambda x, *, groups, axis:
+                     None)  # placeholder replaced below
+
+
+def _maxout(x, *, groups, axis):
+    shp = list(x.shape)
+    ch = shp[axis]
+    new_shape = shp[:axis] + [ch // groups, groups] + shp[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+dispatch.op_registry()["maxout_op"].fn = _maxout
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = as_tensor(x)
+    return dispatch.apply("maxout_op", [x], {"groups": int(groups),
+                                             "axis": normalize_axis(axis, x.ndim)})

@@ -1,0 +1,99 @@
+"""Comparison and logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, make_compare, prep_binary
+
+equal = make_compare("equal", jnp.equal)
+not_equal = make_compare("not_equal", jnp.not_equal)
+greater_than = make_compare("greater_than", jnp.greater)
+greater_equal = make_compare("greater_equal", jnp.greater_equal)
+less_than = make_compare("less_than", jnp.less)
+less_equal = make_compare("less_equal", jnp.less_equal)
+
+logical_and = make_compare("logical_and", jnp.logical_and)
+logical_or = make_compare("logical_or", jnp.logical_or)
+logical_xor = make_compare("logical_xor", jnp.logical_xor)
+
+dispatch.register_op("logical_not", jnp.logical_not)
+
+
+def logical_not(x, name=None):
+    return dispatch.apply("logical_not", [as_tensor(x)])
+
+
+dispatch.register_op("bitwise_and", jnp.bitwise_and)
+dispatch.register_op("bitwise_or", jnp.bitwise_or)
+dispatch.register_op("bitwise_xor", jnp.bitwise_xor)
+dispatch.register_op("bitwise_not", jnp.bitwise_not)
+dispatch.register_op("bitwise_left_shift", jnp.left_shift)
+dispatch.register_op("bitwise_right_shift", jnp.right_shift)
+
+
+def bitwise_and(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("bitwise_and", [x, y])
+
+
+def bitwise_or(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("bitwise_or", [x, y])
+
+
+def bitwise_xor(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("bitwise_xor", [x, y])
+
+
+def bitwise_not(x, name=None):
+    return dispatch.apply("bitwise_not", [as_tensor(x)])
+
+
+def bitwise_left_shift(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("bitwise_left_shift", [x, y])
+
+
+def bitwise_right_shift(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("bitwise_right_shift", [x, y])
+
+
+dispatch.register_op("isclose", lambda x, y, *, rtol, atol, equal_nan: jnp.isclose(
+    x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("isclose", [x, y], {"rtol": float(rtol), "atol": float(atol),
+                                              "equal_nan": bool(equal_nan)})
+
+
+dispatch.register_op("allclose", lambda x, y, *, rtol, atol, equal_nan: jnp.allclose(
+    x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("allclose", [x, y], {"rtol": float(rtol), "atol": float(atol),
+                                               "equal_nan": bool(equal_nan)})
+
+
+dispatch.register_op("equal_all", lambda x, y: jnp.array_equal(x, y))
+
+
+def equal_all(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("equal_all", [x, y])
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(as_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

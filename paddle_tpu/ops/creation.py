@@ -1,0 +1,354 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py + random.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+from ._helpers import as_tensor, shape_to_tuple
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    import jax.numpy as jnp
+
+    if isinstance(data, Tensor):
+        t = data
+        if dtype is not None and t.dtype != dtype_mod.convert_dtype(dtype):
+            from .manipulation import cast
+
+            t = cast(t, dtype)
+        out = Tensor(t._data, stop_gradient=stop_gradient)
+        return out
+    npdtype = dtype_mod.to_np(dtype) if dtype is not None else None
+    if npdtype is None:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64:
+            npdtype = dtype_mod.get_default_dtype().np_dtype
+        elif arr.dtype == np.int32:
+            # python ints -> int64 on some platforms; keep as-is
+            npdtype = arr.dtype
+        else:
+            npdtype = arr.dtype
+        data = arr
+    return Tensor(jnp.asarray(data, dtype=npdtype), stop_gradient=stop_gradient)
+
+
+def _creation_dtype(dtype):
+    return (dtype_mod.to_np(dtype) if dtype is not None
+            else dtype_mod.get_default_dtype().np_dtype)
+
+
+dispatch.register_op("full", lambda *, shape, value, dtype: _jnp().full(shape, value, dtype=np.dtype(dtype)))
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    shape = shape_to_tuple(shape)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtype_mod.get_default_dtype().np_dtype  # paddle uses float32 default
+        else:
+            dtype = dtype_mod.get_default_dtype().np_dtype
+    else:
+        dtype = dtype_mod.to_np(dtype)
+    return dispatch.apply("full", [], {"shape": shape, "value": float(fill_value)
+                                       if np.issubdtype(dtype, np.floating) else fill_value,
+                                       "dtype": dtype.name if hasattr(dtype, "name") else str(dtype)})
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return full(shape, 0, dtype=dtype if dtype is not None else dtype_mod.get_default_dtype())
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return full(shape, 1, dtype=dtype if dtype is not None else dtype_mod.get_default_dtype())
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype=dtype)
+
+
+dispatch.register_op("full_like", lambda x, *, value: _jnp().full_like(x, value))
+dispatch.register_op("zeros_like", lambda x: _jnp().zeros_like(x))
+dispatch.register_op("ones_like", lambda x: _jnp().ones_like(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return dispatch.apply("full_like", [x], {"value": fill_value})
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return dispatch.apply("zeros_like", [x])
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return dispatch.apply("ones_like", [x])
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype=dtype)
+
+
+dispatch.register_op(
+    "arange", lambda *, start, end, step, dtype: _jnp().arange(start, end, step, dtype=np.dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds is not supported; pass python numbers")
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = np.int64
+        else:
+            dtype = dtype_mod.get_default_dtype().np_dtype
+    else:
+        dtype = dtype_mod.to_np(dtype)
+    return dispatch.apply("arange", [], {"start": start, "end": end, "step": step,
+                                         "dtype": np.dtype(dtype).name})
+
+
+dispatch.register_op(
+    "linspace", lambda *, start, stop, num, dtype: _jnp().linspace(start, stop, num, dtype=np.dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    dtype = _creation_dtype(dtype)
+    return dispatch.apply("linspace", [], {"start": float(start), "stop": float(stop),
+                                           "num": int(num), "dtype": np.dtype(dtype).name})
+
+
+dispatch.register_op("eye", lambda *, n, m, dtype: _jnp().eye(n, m, dtype=np.dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    dtype = _creation_dtype(dtype)
+    m = int(num_columns) if num_columns is not None else int(num_rows)
+    return dispatch.apply("eye", [], {"n": int(num_rows), "m": m,
+                                      "dtype": np.dtype(dtype).name})
+
+
+dispatch.register_op("tril", lambda x, *, diagonal: _jnp().tril(x, k=diagonal))
+dispatch.register_op("triu", lambda x, *, diagonal: _jnp().triu(x, k=diagonal))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return dispatch.apply("tril", [as_tensor(x)], {"diagonal": int(diagonal)})
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return dispatch.apply("triu", [as_tensor(x)], {"diagonal": int(diagonal)})
+
+
+dispatch.register_op("diag", lambda x, *, offset: _jnp().diag(x, k=offset))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = as_tensor(x)
+    out = dispatch.apply("diag", [x], {"offset": int(offset)})
+    if padding_value != 0 and x.ndim == 1:
+        from . import creation as _c
+        from .math import add, multiply
+        from .comparison import equal
+
+        import jax.numpy as jnp
+
+        mask = Tensor(jnp.eye(out._data.shape[0], out._data.shape[1],
+                              k=offset, dtype=bool))
+        from .manipulation import where
+
+        out = where(mask, out, full_like(out, padding_value))
+    return out
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    from .manipulation import flatten
+
+    return diag(flatten(as_tensor(x)), offset=offset)
+
+
+dispatch.register_op("assign", lambda a: a + 0)
+
+
+def assign(x, output=None) -> Tensor:
+    out = dispatch.apply("assign", [as_tensor(x)])
+    if output is not None:
+        output._copy_data_from(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return assign(x)
+
+
+# ---------------------------------------------------------------------------
+# Random creation (eager draws a key from the global generator; see
+# framework/random.py — reference analog phi/kernels/gpu/uniform_kernel.cu etc.)
+# ---------------------------------------------------------------------------
+
+
+def _rand_op(name, sampler):
+    def fn(key, *, shape, dtype, **kw):
+        import jax
+
+        return sampler(key, shape, np.dtype(dtype), **kw)
+
+    dispatch.register_op(name, fn)
+
+
+def _key_tensor():
+    return random_mod.next_key()
+
+
+import jax as _jax_mod  # noqa: E402
+
+_rand_op("uniform_random",
+         lambda key, shape, dtype, min, max: _jax_mod.random.uniform(
+             key, shape, dtype, minval=min, maxval=max))
+_rand_op("gaussian_random",
+         lambda key, shape, dtype, mean, std: _jax_mod.random.normal(key, shape, dtype) * std + mean)
+_rand_op("randint",
+         lambda key, shape, dtype, low, high: _jax_mod.random.randint(key, shape, low, high, dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    shape = shape_to_tuple(shape)
+    dtype = _creation_dtype(dtype)
+    return dispatch.apply("uniform_random", [_key_tensor()],
+                          {"shape": shape, "dtype": np.dtype(dtype).name,
+                           "min": float(min), "max": float(max)})
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)
+        s = as_tensor(std)
+        shp = tuple(m.shape if isinstance(mean, Tensor) else s.shape)
+        g = dispatch.apply("gaussian_random", [_key_tensor()],
+                           {"shape": shp, "dtype": np.dtype(dtype_mod.get_default_dtype().np_dtype).name,
+                            "mean": 0.0, "std": 1.0})
+        from .math import add, multiply
+
+        return add(multiply(g, s), m)
+    shape = shape_to_tuple(shape)
+    dtype = dtype_mod.get_default_dtype().np_dtype
+    return dispatch.apply("gaussian_random", [_key_tensor()],
+                          {"shape": shape, "dtype": np.dtype(dtype).name,
+                           "mean": float(mean), "std": float(std)})
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    shape = shape_to_tuple(shape)
+    dtype = _creation_dtype(dtype)
+    return dispatch.apply("gaussian_random", [_key_tensor()],
+                          {"shape": shape, "dtype": np.dtype(dtype).name,
+                           "mean": 0.0, "std": 1.0})
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    shape = shape_to_tuple(shape)
+    dtype = np.dtype(dtype_mod.to_np(dtype)) if dtype is not None else np.dtype(np.int64)
+    return dispatch.apply("randint", [_key_tensor()],
+                          {"shape": shape, "dtype": dtype.name,
+                           "low": int(low), "high": int(high)})
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    import jax
+
+    key = _key_tensor()
+    dispatch.register_op("randperm", lambda key, *, n, dtype: jax.random.permutation(
+        key, n).astype(np.dtype(dtype))) if "randperm" not in dispatch.op_registry() else None
+    return dispatch.apply("randperm", [key], {"n": int(n), "dtype": np.dtype(dtype_mod.to_np(dtype)).name})
+
+
+dispatch.register_op("randperm", lambda key, *, n, dtype: _jax_mod.random.permutation(
+    key, n).astype(np.dtype(dtype)))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    if "bernoulli" not in dispatch.op_registry():
+        dispatch.register_op("bernoulli", lambda key, p: _jax_mod.random.bernoulli(
+            key, p).astype(p.dtype))
+    return dispatch.apply("bernoulli", [_key_tensor(), x])
+
+
+dispatch.register_op("bernoulli", lambda key, p: _jax_mod.random.bernoulli(
+    key, p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    import jax
+
+    x = as_tensor(x)
+    key = _key_tensor()
+    opname = "multinomial_rep" if replacement else "multinomial_norep"
+    if opname not in dispatch.op_registry():
+        def fn(key, p, *, n, replace):
+            logits = jax.numpy.log(jax.numpy.maximum(p, 1e-30))
+            if p.ndim == 1:
+                return jax.random.choice(key, p.shape[-1], shape=(n,),
+                                         replace=replace, p=p / p.sum())
+            keys = jax.random.split(key, p.shape[0])
+            return jax.vmap(lambda k, pi: jax.random.choice(
+                k, p.shape[-1], shape=(n,), replace=replace, p=pi / pi.sum()))(keys, p)
+
+        dispatch.register_op(opname, fn)
+    return dispatch.apply(opname, [key, x], {"n": int(num_samples), "replace": replacement})
+
+
+def meshgrid(*args, **kwargs):
+    import jax.numpy as jnp
+
+    tensors = [as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    name = f"meshgrid_{len(tensors)}"
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                             multi_out=True)
+    return dispatch.apply(name, tensors)
+
+
+def clone_detached(x):
+    return x.detach()

@@ -1,0 +1,304 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; matmul lowers to the
+MXU via XLA dot_general — the analog of the cuBLAS path in `phi/kernels/funcs/blas/`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ._helpers import as_tensor, normalize_axis, prep_binary
+
+
+def _reg(name, fn, multi_out=False):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn, multi_out=multi_out)
+
+
+def _mm(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    # bf16/f16 inputs accumulate in f32 on the MXU ("highest" widens the
+    # accumulation, not the storage dtype)
+    prec = jax.lax.Precision.DEFAULT
+    return jnp.matmul(x, y, precision=prec)
+
+
+_reg("matmul", lambda x, y, *, transpose_x, transpose_y: _mm(x, y, transpose_x, transpose_y))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("matmul", [x, y], {"transpose_x": bool(transpose_x),
+                                             "transpose_y": bool(transpose_y)})
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+_reg("dot", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("dot", [x, y])
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+_reg("cross", lambda x, y, *, axis: jnp.cross(x, y, axis=axis))
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = prep_binary(x, y)
+    if axis == 9:  # paddle default: first axis with dim 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return dispatch.apply("cross", [x, y], {"axis": normalize_axis(axis, x.ndim)})
+
+
+_reg("p_norm", lambda x, *, p, axis, keepdim: _pnorm_impl(x, p, axis, keepdim))
+
+
+def _pnorm_impl(x, p, axis, keepdim):
+    if p == "fro" or p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == "inf" or p == np.inf:
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == "-inf" or p == -np.inf:
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim),
+                     1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        from .manipulation import cast
+
+        x = cast(x, dtype_mod.get_default_dtype())
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    ax = normalize_axis(axis, x.ndim)
+    pk = p if isinstance(p, (int, float)) else str(p)
+    return dispatch.apply("p_norm", [x], {"p": pk, "axis": ax, "keepdim": bool(keepdim)})
+
+
+def dist(x, y, p=2, name=None):
+    from .math import subtract
+
+    return norm(subtract(x, y), p=p)
+
+
+_reg("histogram", lambda x, *, bins, min, max: jnp.histogram(
+    x, bins=bins, range=(min, max) if (min != 0 or max != 0) else None)[0].astype(np.int64))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    return dispatch.apply("histogram", [as_tensor(input)],
+                          {"bins": int(bins), "min": float(min), "max": float(max)})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    n = int(np.asarray(x.numpy()).max()) + 1 if x.size else 0
+    length = max(n, int(minlength))
+    if weights is None:
+        _reg("bincount_nw", lambda x, *, length: jnp.bincount(x, length=length).astype(np.int64))
+        return dispatch.apply("bincount_nw", [x], {"length": length})
+    _reg("bincount_w", lambda x, w, *, length: jnp.bincount(x, weights=w, length=length))
+    return dispatch.apply("bincount_w", [x, as_tensor(weights)], {"length": length})
+
+
+# -- decompositions / solvers (XLA has QR/SVD/Cholesky/LU on TPU via custom calls;
+#    these run fine on CPU backend too) --------------------------------------
+_reg("cholesky", lambda x, *, upper: jnp.linalg.cholesky(x) if not upper
+     else jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2).conj())
+
+
+def cholesky(x, upper=False, name=None):
+    return dispatch.apply("cholesky", [as_tensor(x)], {"upper": bool(upper)})
+
+
+_reg("qr_reduced", lambda x: tuple(jnp.linalg.qr(x, mode="reduced")), multi_out=True)
+_reg("qr_complete", lambda x: tuple(jnp.linalg.qr(x, mode="complete")), multi_out=True)
+
+
+def qr(x, mode="reduced", name=None):
+    return tuple(dispatch.apply(f"qr_{mode}", [as_tensor(x)]))
+
+
+_reg("svd_full", lambda x: tuple(jnp.linalg.svd(x, full_matrices=True)), multi_out=True)
+_reg("svd_thin", lambda x: tuple(jnp.linalg.svd(x, full_matrices=False)), multi_out=True)
+
+
+def svd(x, full_matrices=False, name=None):
+    return tuple(dispatch.apply("svd_full" if full_matrices else "svd_thin", [as_tensor(x)]))
+
+
+_reg("inverse", jnp.linalg.inv)
+
+
+def inv(x, name=None):
+    return dispatch.apply("inverse", [as_tensor(x)])
+
+
+inverse = inv
+
+
+_reg("pinv", lambda x, *, rcond: jnp.linalg.pinv(x, rtol=rcond))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch.apply("pinv", [as_tensor(x)], {"rcond": float(rcond)})
+
+
+_reg("matrix_solve", jnp.linalg.solve)
+
+
+def solve(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("matrix_solve", [x, y])
+
+
+_reg("triangular_solve", lambda a, b, *, upper, transpose, unitriangular:
+     jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                       unit_diagonal=unitriangular))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("triangular_solve", [x, y],
+                          {"upper": bool(upper), "transpose": bool(transpose),
+                           "unitriangular": bool(unitriangular)})
+
+
+_reg("cholesky_solve", lambda b, l, *, upper: jax.scipy.linalg.cho_solve((l, not upper), b))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return dispatch.apply("cholesky_solve", [as_tensor(x), as_tensor(y)], {"upper": bool(upper)})
+
+
+_reg("lu_op", lambda x: tuple(jax.scipy.linalg.lu(x)), multi_out=True)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    return tuple(dispatch.apply("lu_op", [as_tensor(x)]))
+
+
+_reg("det", jnp.linalg.det)
+
+
+def det(x, name=None):
+    return dispatch.apply("det", [as_tensor(x)])
+
+
+_reg("slogdet", lambda x: tuple(jnp.linalg.slogdet(x)), multi_out=True)
+
+
+def slogdet(x, name=None):
+    return tuple(dispatch.apply("slogdet", [as_tensor(x)]))
+
+
+_reg("eig", lambda x: tuple(jnp.linalg.eig(x)), multi_out=True)
+_reg("eigh_op", lambda x, *, uplo: tuple(jnp.linalg.eigh(x, UPLO=uplo)), multi_out=True)
+_reg("eigvals", jnp.linalg.eigvals)
+_reg("eigvalsh_op", lambda x, *, uplo: jnp.linalg.eigvalsh(x, UPLO=uplo))
+
+
+def eig(x, name=None):
+    return tuple(dispatch.apply("eig", [as_tensor(x)]))
+
+
+def eigh(x, UPLO="L", name=None):
+    return tuple(dispatch.apply("eigh_op", [as_tensor(x)], {"uplo": UPLO}))
+
+
+def eigvals(x, name=None):
+    return dispatch.apply("eigvals", [as_tensor(x)])
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch.apply("eigvalsh_op", [as_tensor(x)], {"uplo": UPLO})
+
+
+_reg("matrix_power", lambda x, *, n: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_power(x, n, name=None):
+    return dispatch.apply("matrix_power", [as_tensor(x)], {"n": int(n)})
+
+
+_reg("matrix_rank_tol", lambda x, *, tol: jnp.linalg.matrix_rank(x, tol=tol))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return dispatch.apply("matrix_rank_tol", [as_tensor(x)],
+                          {"tol": float(tol) if tol is not None else None})
+
+
+_reg("multi_dot2", lambda a, b: a @ b)
+
+
+def multi_dot(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    out = ts[0]
+    for t in ts[1:]:
+        out = matmul(out, t)
+    return out
+
+
+_reg("lstsq_op", lambda a, b: tuple(jnp.linalg.lstsq(a, b)), multi_out=True)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return tuple(dispatch.apply("lstsq_op", [as_tensor(x), as_tensor(y)]))
+
+
+_reg("corrcoef_op", lambda x, *, rowvar: jnp.corrcoef(x, rowvar=rowvar))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch.apply("corrcoef_op", [as_tensor(x)], {"rowvar": bool(rowvar)})
+
+
+_reg("cov_op", lambda x, *, rowvar, ddof: jnp.cov(x, rowvar=rowvar, ddof=ddof))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return dispatch.apply("cov_op", [as_tensor(x)],
+                          {"rowvar": bool(rowvar), "ddof": 1 if ddof else 0})
+
+
+def cond(x, p=None, name=None):
+    _reg("cond_op", lambda x, *, p: jnp.linalg.cond(x, p=p))
+    pk = p if isinstance(p, (int, float)) or p is None else str(p)
+    return dispatch.apply("cond_op", [as_tensor(x)], {"p": pk})
+
+
+def einsum(equation, *operands):
+    ts = [as_tensor(t) for t in operands]
+    opname = f"einsum_{len(ts)}"
+    _reg(opname, lambda *xs, eq: jnp.einsum(eq, *xs))
+    return dispatch.apply(opname, ts, {"eq": equation})
+
+
+def matrix_transpose(x, name=None):
+    from .manipulation import swapaxes
+
+    return swapaxes(x, -1, -2)

@@ -1,0 +1,809 @@
+"""Shape/layout/index manipulation ops
+(reference: python/paddle/tensor/manipulation.py, phi kernels concat/split/gather/...)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ._helpers import (as_tensor, inplace_rebind, normalize_axis,
+                       prep_binary, shape_to_tuple)
+
+
+def _reg(name, fn, multi_out=False):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn, multi_out=multi_out)
+
+
+# -- cast --------------------------------------------------------------------
+_reg("cast", lambda x, *, dtype: x.astype(np.dtype(dtype)))
+
+
+def cast(x, dtype, name=None):
+    x = as_tensor(x)
+    d = dtype_mod.convert_dtype(dtype)
+    if x.dtype == d:
+        return x
+    return dispatch.apply("cast", [x], {"dtype": d.np_dtype.name
+                                        if d.name != "bfloat16" else "bfloat16"})
+
+
+def _cast_fix():
+    # np.dtype("bfloat16") isn't resolvable by name through numpy alone; route
+    # through our dtype table instead.
+    def fn(x, *, dtype):
+        return x.astype(dtype_mod.convert_dtype(dtype).np_dtype)
+
+    dispatch.op_registry()["cast"].fn = fn
+
+
+_cast_fix()
+
+astype = cast
+
+# -- reshape family ----------------------------------------------------------
+_reg("reshape", lambda x, *, shape: jnp.reshape(x, shape))
+
+
+def reshape(x, shape, name=None):
+    x = as_tensor(x)
+    return dispatch.apply("reshape", [x], {"shape": shape_to_tuple(shape)})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return inplace_rebind(x, out)
+
+
+view = reshape
+
+
+_reg("transpose", lambda x, *, perm: jnp.transpose(x, perm))
+
+
+def transpose(x, perm=None, name=None):
+    x = as_tensor(x)
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return dispatch.apply("transpose", [x], {"perm": tuple(int(p) for p in perm)})
+
+
+def t(x, name=None):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        return x
+    if x.ndim != 2:
+        raise ValueError("t() expects a 0/1/2-D tensor; use transpose for N-D")
+    return transpose(x, [1, 0])
+
+
+def t_(x, name=None):
+    out = t(x)
+    return inplace_rebind(x, out)
+
+
+_reg("moveaxis", lambda x, *, src, dst: jnp.moveaxis(x, src, dst))
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch.apply("moveaxis", [as_tensor(x)],
+                          {"src": tuple(np.atleast_1d(source).tolist()),
+                           "dst": tuple(np.atleast_1d(destination).tolist())})
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = as_tensor(x)
+    perm = list(range(x.ndim))
+    a0, a1 = normalize_axis(axis0, x.ndim), normalize_axis(axis1, x.ndim)
+    perm[a0], perm[a1] = perm[a1], perm[a0]
+    return transpose(x, perm)
+
+
+transpose_last_two = None  # reserved
+
+
+_reg("flatten", lambda x, *, start, stop: jax.lax.collapse(x, start, stop + 1))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = max(x.ndim, 1)
+    start = normalize_axis(start_axis, nd)
+    stop = normalize_axis(stop_axis, nd)
+    if x.ndim == 0:
+        return reshape(x, [1])
+    return dispatch.apply("flatten", [x], {"start": start, "stop": stop})
+
+
+_reg("squeeze", lambda x, *, axis: jnp.squeeze(x, axis=axis))
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    if axis is not None:
+        ax = normalize_axis(axis, x.ndim)
+        if isinstance(ax, int):
+            ax = (ax,)
+        ax = tuple(a for a in ax if x._data.shape[a] == 1)
+        if not ax:
+            return x
+    else:
+        ax = None
+    return dispatch.apply("squeeze", [x], {"axis": ax})
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    return inplace_rebind(x, out)
+
+
+_reg("unsqueeze", lambda x, *, axis: jnp.expand_dims(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    return dispatch.apply("unsqueeze", [x], {"axis": ax})
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    return inplace_rebind(x, out)
+
+
+# -- combine / split ---------------------------------------------------------
+def concat(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    # promote to common dtype
+    common = tensors[0]._data.dtype
+    for t in tensors[1:]:
+        from ._helpers import result_dtype
+
+        common = result_dtype(common, t._data.dtype)
+    tensors = [cast(t, common) for t in tensors]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    opname = f"concat_{len(tensors)}"
+    _reg(opname, lambda *xs, axis: jnp.concatenate(xs, axis=axis))
+    return dispatch.apply(opname, tensors, {"axis": int(axis)})
+
+
+def stack(x, axis=0, name=None):
+    tensors = [as_tensor(t) for t in x]
+    opname = f"stack_{len(tensors)}"
+    _reg(opname, lambda *xs, axis: jnp.stack(xs, axis=axis))
+    return dispatch.apply(opname, tensors, {"axis": int(axis)})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    dim = x._data.shape[ax]
+    if isinstance(num_or_sections, int):
+        sections = None
+        n = num_or_sections
+        key = ("n", n)
+    else:
+        secs = [s if not isinstance(s, Tensor) else int(s.item()) for s in num_or_sections]
+        total_known = builtins_sum(s for s in secs if s not in (-1,))
+        secs = [dim - total_known if s == -1 else s for s in secs]
+        sections = tuple(np.cumsum(secs[:-1]).tolist())
+        n = len(secs)
+        key = ("s", sections)
+    opname = f"split_{n}"
+    _reg(opname, lambda x, *, indices, axis: tuple(jnp.split(x, indices, axis=axis)),
+         multi_out=True)
+    indices = sections if sections is not None else n
+    return dispatch.apply(opname, [x], {"indices": indices, "axis": ax})
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis, x.ndim)
+    n = x._data.shape[ax]
+    opname = f"unbind_{n}_{ax}"
+    _reg(opname, lambda x, *, ax: tuple(
+        jnp.squeeze(s, ax) for s in jnp.split(x, x.shape[ax], axis=ax)), multi_out=True)
+    return dispatch.apply(opname, [x], {"ax": ax})
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+# -- broadcast / tile --------------------------------------------------------
+_reg("broadcast_to", lambda x, *, shape: jnp.broadcast_to(x, shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return dispatch.apply("broadcast_to", [as_tensor(x)], {"shape": shape_to_tuple(shape)})
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    shape = list(shape_to_tuple(shape))
+    # paddle expand allows -1 meaning keep dim
+    xs = list(x._data.shape)
+    xs = [1] * (len(shape) - len(xs)) + xs
+    shape = [xs[i] if s == -1 else s for i, s in enumerate(shape)]
+    return broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return broadcast_to(x, as_tensor(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [broadcast_to(t, shape) for t in ts]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+_reg("tile", lambda x, *, reps: jnp.tile(x, reps))
+
+
+def tile(x, repeat_times, name=None):
+    return dispatch.apply("tile", [as_tensor(x)], {"reps": shape_to_tuple(repeat_times)})
+
+
+_reg("repeat_interleave", lambda x, *, repeats, axis: jnp.repeat(x, repeats, axis=axis))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        repeats = tuple(repeats.numpy().tolist())
+    return dispatch.apply("repeat_interleave", [x],
+                          {"repeats": repeats, "axis": normalize_axis(axis, x.ndim)})
+
+
+_reg("flip", lambda x, *, axis: jnp.flip(x, axis=axis))
+
+
+def flip(x, axis, name=None):
+    x = as_tensor(x)
+    ax = normalize_axis(axis if isinstance(axis, (list, tuple)) else [axis], x.ndim)
+    return dispatch.apply("flip", [x], {"axis": ax})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    _reg("rot90", lambda x, *, k, axes: jnp.rot90(x, k=k, axes=axes))
+    return dispatch.apply("rot90", [as_tensor(x)], {"k": int(k), "axes": tuple(axes)})
+
+
+_reg("roll", lambda x, *, shifts, axis: jnp.roll(x, shifts, axis=axis))
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(shifts, Tensor):
+        shifts = tuple(int(v) for v in shifts.numpy().tolist())
+    elif isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    else:
+        shifts = int(shifts)
+    ax = normalize_axis(axis, x.ndim) if axis is not None else None
+    return dispatch.apply("roll", [x], {"shifts": shifts, "axis": ax})
+
+
+# -- pad ---------------------------------------------------------------------
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = tuple(int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle full-form: [d0_l, d0_r, d1_l, d1_r, ...]? actually paddle uses
+        # per-dim ascending; numpy wants ((l,r), ...) per dim
+        widths = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # torch-style last-dims-first pairs, e.g. NCHW conv pad [l, r, t, b]
+        n_pairs = len(pad) // 2
+        widths_rev = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_pairs)]
+        widths = [(0, 0)] * (nd - n_pairs) + list(reversed(widths_rev))
+        if data_format == "NHWC" and n_pairs < nd - 1:
+            widths = ([(0, 0)] + list(reversed(widths_rev)) + [(0, 0)] * (nd - n_pairs - 1))
+        widths = tuple(widths)
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    opname = "pad"
+    if opname not in dispatch.op_registry():
+        def fn(x, *, widths, jmode, value):
+            if jmode == "constant":
+                return jnp.pad(x, widths, mode="constant", constant_values=value)
+            return jnp.pad(x, widths, mode=jmode)
+
+        dispatch.register_op(opname, fn)
+    return dispatch.apply(opname, [x], {"widths": widths, "jmode": jmode,
+                                        "value": float(value)})
+
+
+# -- gather / scatter / index ------------------------------------------------
+_reg("gather", lambda x, idx, *, axis: jnp.take(x, idx, axis=axis))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    if index.ndim == 2 and index._data.shape[1] == 1:
+        index = squeeze(index, 1)
+    return dispatch.apply("gather", [x, index],
+                          {"axis": normalize_axis(axis, x.ndim) if axis is not None else 0})
+
+
+_reg("gather_nd", lambda x, idx: x[tuple(jnp.moveaxis(idx, -1, 0))])
+
+
+def gather_nd(x, index, name=None):
+    return dispatch.apply("gather_nd", [as_tensor(x), as_tensor(index)])
+
+
+_reg("take_along_axis", lambda x, idx, *, axis: jnp.take_along_axis(x, idx, axis=axis))
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    x, idx = as_tensor(x), as_tensor(indices)
+    if broadcast:
+        # broadcast indices against x except on `axis`
+        tgt = list(x.shape)
+        tgt[normalize_axis(axis, x.ndim)] = idx._data.shape[normalize_axis(axis, idx.ndim)] if idx.ndim == x.ndim else idx._data.shape[-1]
+        if list(idx.shape) != tgt and idx.ndim == x.ndim:
+            idx = broadcast_to(idx, tgt)
+    return dispatch.apply("take_along_axis", [x, idx],
+                          {"axis": normalize_axis(axis, x.ndim)})
+
+
+_reg("put_along_axis", lambda x, idx, v, *, axis, reduce:
+     _put_along_axis_impl(x, idx, v, axis, reduce))
+
+
+def _put_along_axis_impl(x, idx, v, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, idx, v, axis=axis, inplace=False)
+    # build scatter via explicit indices
+    idx_full = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    idx_tuple = list(idx_full)
+    idx_tuple[axis] = idx
+    v = jnp.broadcast_to(v, idx.shape)
+    at = x.at[tuple(idx_tuple)]
+    if reduce == "add":
+        return at.add(v)
+    if reduce == "multiply" or reduce == "mul":
+        return at.multiply(v)
+    if reduce == "amax":
+        return at.max(v)
+    if reduce == "amin":
+        return at.min(v)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    x, idx = as_tensor(x), as_tensor(indices)
+    if not isinstance(values, Tensor):
+        values = as_tensor(values, dtype=x.dtype)
+    values = cast(values, x.dtype)
+    return dispatch.apply("put_along_axis", [x, idx, values],
+                          {"axis": normalize_axis(axis, x.ndim), "reduce": reduce})
+
+
+_reg("index_select", lambda x, idx, *, axis: jnp.take(x, idx, axis=axis))
+
+
+def index_select(x, index, axis=0, name=None):
+    x = as_tensor(x)
+    return dispatch.apply("index_select", [x, as_tensor(index)],
+                          {"axis": normalize_axis(axis, x.ndim)})
+
+
+_reg("index_sample", lambda x, idx: jnp.take_along_axis(x, idx, axis=1))
+
+
+def index_sample(x, index, name=None):
+    return dispatch.apply("index_sample", [as_tensor(x), as_tensor(index)])
+
+
+def _scatter_impl(x, index, updates, overwrite):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].set(jnp.zeros_like(updates)).at[index].add(updates)
+
+
+_reg("scatter", lambda x, idx, upd, *, overwrite: _scatter_impl(x, idx, upd, overwrite))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return dispatch.apply("scatter", [as_tensor(x), as_tensor(index), as_tensor(updates)],
+                          {"overwrite": bool(overwrite)})
+
+
+_reg("scatter_nd_add", lambda x, idx, upd: x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return dispatch.apply("scatter_nd_add", [as_tensor(x), as_tensor(index), as_tensor(updates)])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    updates = as_tensor(updates)
+    zeros_t = full_shape_zeros(shape, updates.dtype)
+    return scatter_nd_add(zeros_t, index, updates)
+
+
+def full_shape_zeros(shape, dtype):
+    from .creation import zeros
+
+    return zeros(shape_to_tuple(shape), dtype=dtype)
+
+
+# -- where / select ----------------------------------------------------------
+_reg("where", lambda c, x, y: jnp.where(c, x, y))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = prep_binary(x, y)
+    return dispatch.apply("where", [condition, x, y])
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    if isinstance(x, Tensor):
+        x._data, x._grad_node, x._out_index = out._data, out._grad_node, out._out_index
+        return x
+    return out
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x = as_tensor(x)
+    v = as_tensor(values)
+    idx = [builtins.slice(None)] * x.ndim
+    idx[normalize_axis(axis, x.ndim)] = index
+    opname = "select_scatter"
+    _reg(opname, lambda x, v, *, idx_spec: x.at[_decode_index(idx_spec, [])].set(v))
+    return dispatch.apply(opname, [x, v], {"idx_spec": _encode_index(tuple(idx), [])})
+
+
+# -- sort / search -----------------------------------------------------------
+_reg("topk", lambda x, *, k, axis, largest, sorted: _topk_impl(x, k, axis, largest),
+     multi_out=True)
+
+
+def _topk_impl(x, k, axis, largest):
+    if axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        v, i = jax.lax.top_k(xm, k)
+    else:
+        v, i = jax.lax.top_k(-xm, k)
+        v = -v
+    if axis != x.ndim - 1:
+        v = jnp.moveaxis(v, -1, axis)
+        i = jnp.moveaxis(i, -1, axis)
+    return v, i.astype(np.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = normalize_axis(axis if axis is not None else -1, x.ndim)
+    return tuple(dispatch.apply("topk", [x], {"k": int(k), "axis": ax,
+                                              "largest": bool(largest), "sorted": bool(sorted)}))
+
+
+_reg("sort_op", lambda x, *, axis, desc: -jnp.sort(-x, axis=axis) if desc
+     else jnp.sort(x, axis=axis))
+_reg("argsort_op", lambda x, *, axis, desc: jnp.argsort(
+    -x if desc else x, axis=axis).astype(np.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+    return dispatch.apply("sort_op", [x], {"axis": normalize_axis(axis, x.ndim),
+                                           "desc": bool(descending)})
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+    return dispatch.apply("argsort_op", [x], {"axis": normalize_axis(axis, x.ndim),
+                                              "desc": bool(descending)})
+
+
+_reg("searchsorted", lambda a, v, *, right: jnp.searchsorted(
+    a, v, side="right" if right else "left").astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = dispatch.apply("searchsorted", [as_tensor(sorted_sequence), as_tensor(values)],
+                         {"right": bool(right)})
+    return cast(out, "int32") if out_int32 else out
+
+
+_reg("bucketize", lambda x, b, *, right: jnp.digitize(x, b, right=not right).astype(np.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = dispatch.apply("bucketize", [as_tensor(x), as_tensor(sorted_sequence)],
+                         {"right": bool(right)})
+    return cast(out, "int32") if out_int32 else out
+
+
+# -- dynamic-shape ops (eager-only: fall back to host numpy) ----------------
+def nonzero(x, as_tuple=False):
+    x = as_tensor(x)
+    arr = np.asarray(x.numpy())
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=np.int64)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1), dtype=np.int64))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    # data-dependent shape: mask resolved on host, values gathered on device so
+    # the differentiable path stays on-device
+    flat_idx = np.nonzero(mask.numpy().astype(bool).reshape(-1))[0]
+    return gather(reshape(x, [-1]), Tensor(jnp.asarray(flat_idx, dtype=np.int64)))
+
+
+def masked_fill(x, mask, value, name=None):
+    x = as_tensor(x)
+    mask = as_tensor(mask)
+    if isinstance(value, Tensor):
+        v = cast(value, x.dtype)
+    else:
+        v = as_tensor(value, dtype=x.dtype)
+    vb = broadcast_to(v, x.shape) if v.size == 1 else v
+    return where(mask, vb, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = np.unique(x.numpy(), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = as_tensor(x)
+    arr = x.numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        vals = arr[change]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(change) - 1
+            outs.append(Tensor(jnp.asarray(inv, dtype=np.int64)))
+        if return_counts:
+            idx = np.nonzero(change)[0]
+            counts = np.diff(np.concatenate([idx, [len(arr)]]))
+            outs.append(Tensor(jnp.asarray(counts, dtype=np.int64)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+# -- slicing (getitem / setitem) --------------------------------------------
+def _encode_index(idx, tensor_list):
+    """Encode an index tuple into a hashable spec; Tensors go into tensor_list."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if np.dtype(it._data.dtype) == np.bool_:
+                spec.append(("bool_tensor", len(tensor_list)))
+            else:
+                spec.append(("tensor", len(tensor_list)))
+            tensor_list.append(it)
+        elif isinstance(it, builtins.slice):
+            spec.append(("slice",
+                         None if it.start is None else int(it.start),
+                         None if it.stop is None else int(it.stop),
+                         None if it.step is None else int(it.step)))
+        elif it is None:
+            spec.append(("none",))
+        elif it is Ellipsis:
+            spec.append(("ellipsis",))
+        elif isinstance(it, (int, np.integer)):
+            spec.append(("int", int(it)))
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            t = Tensor(jnp.asarray(arr))
+            if arr.dtype == np.bool_:
+                spec.append(("bool_tensor", len(tensor_list)))
+            else:
+                spec.append(("tensor", len(tensor_list)))
+            tensor_list.append(t)
+        elif isinstance(it, (bool, np.bool_)):
+            spec.append(("newbool", bool(it)))
+        else:
+            raise TypeError(f"unsupported index type {type(it)}")
+    return tuple(spec)
+
+
+def _decode_index(spec, arrays):
+    out = []
+    for s in spec:
+        kind = s[0]
+        if kind in ("tensor", "bool_tensor"):
+            out.append(arrays[s[1]])
+        elif kind == "slice":
+            out.append(builtins.slice(s[1], s[2], s[3]))
+        elif kind == "none":
+            out.append(None)
+        elif kind == "ellipsis":
+            out.append(Ellipsis)
+        elif kind == "int":
+            out.append(s[1])
+        elif kind == "newbool":
+            out.append(s[1])
+    return tuple(out)
+
+
+def getitem(x, idx):
+    x = as_tensor(x)
+    tensors = []
+    spec = _encode_index(idx, tensors)
+    has_bool = any(s[0] == "bool_tensor" for s in spec)
+    if has_bool:
+        # data-dependent output shape: resolve mask on host (eager only;
+        # in traced code users should use where/masked ops instead)
+        if len(spec) == 1:
+            mask = tensors[0]
+            flat_idx = np.nonzero(mask.numpy().astype(bool).reshape(-1))[0]
+            flat = reshape(x, [-1] + list(x.shape[mask.ndim:]))
+            return gather(flat, Tensor(jnp.asarray(flat_idx, dtype=np.int64)))
+        raise NotImplementedError("mixed boolean-mask indexing; use paddle.where")
+    opname = "getitem"
+    _reg(opname, lambda x, *arrays, spec: x[_decode_index(spec, arrays)])
+    return dispatch.apply(opname, [x] + tensors, {"spec": spec})
+
+
+def setitem(x, idx, value):
+    x_t = as_tensor(x)
+    tensors = []
+    spec = _encode_index(idx, tensors)
+    if any(s[0] == "bool_tensor" for s in spec) and len(spec) == 1:
+        mask = tensors[0]
+        if not isinstance(value, Tensor):
+            value = as_tensor(value, dtype=x_t.dtype)
+        value = cast(value, x_t.dtype)
+        vb = broadcast_to(value, x_t.shape) if value.size == 1 else value
+        out = where(mask, vb, x_t)
+    else:
+        if not isinstance(value, Tensor):
+            value = as_tensor(value, dtype=x_t.dtype)
+        value = cast(value, x_t.dtype)
+        opname = "setitem"
+        _reg(opname, lambda x, v, *arrays, spec: x.at[_decode_index(spec, arrays)].set(v))
+        out = dispatch.apply(opname, [x_t, value] + tensors, {"spec": spec})
+    # in-place rebind (paddle __setitem__ semantics)
+    return inplace_rebind(x, out)
+
+
+def slice(input, axes, starts, ends):
+    import builtins
+
+    input = as_tensor(input)
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        idx[ax] = builtins.slice(st, en)
+    return getitem(input, tuple(idx))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    x = as_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(st), int(en), int(sd))
+    return getitem(x, tuple(idx))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    x = as_tensor(x)
+    shape = shape_to_tuple(shape)
+    offsets = shape_to_tuple(offsets) if offsets is not None else (0,) * x.ndim
+    idx = tuple(builtins.slice(o, o + s if s != -1 else None)
+                for o, s in zip(offsets, shape))
+    return getitem(x, idx)
+
+
+# -- numel / shape helpers ---------------------------------------------------
+def shape(x):
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(np.asarray(x._data.shape, dtype=np.int64)))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(np.int64(as_tensor(x).size)))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(np.int64(as_tensor(x).ndim)))
+
+
+def as_complex(x, name=None):
+    _reg("as_complex", lambda x: jax.lax.complex(x[..., 0], x[..., 1]))
+    return dispatch.apply("as_complex", [as_tensor(x)])
+
+
+def as_real(x, name=None):
+    _reg("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1))
+    return dispatch.apply("as_real", [as_tensor(x)])
+
+
+def one_hot(x, num_classes, name=None):
+    _reg("one_hot", lambda x, *, n: jax.nn.one_hot(x, n, dtype=np.float32))
+    return dispatch.apply("one_hot", [as_tensor(x)], {"n": int(num_classes)})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    _reg("diagonal", lambda x, *, offset, a1, a2: jnp.diagonal(x, offset, a1, a2))
+    x = as_tensor(x)
+    return dispatch.apply("diagonal", [x], {"offset": int(offset),
+                                            "a1": normalize_axis(axis1, x.ndim),
+                                            "a2": normalize_axis(axis2, x.ndim)})
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = as_tensor(x)
+
+    def fn(x, *, offset, dim1, dim2):
+        n = x.shape[-1] + abs(offset)
+        base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+        i = jnp.arange(x.shape[-1])
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        out = base.at[..., rows, cols].set(x)
+        # move the two new dims into place
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    _reg("diag_embed", fn)
+    return dispatch.apply("diag_embed", [x], {"offset": int(offset),
+                                              "dim1": int(dim1), "dim2": int(dim2)})

@@ -1,0 +1,297 @@
+"""Elementwise math ops (reference: python/paddle/tensor/math.py, phi elementwise kernels)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ._helpers import (as_tensor, inplace_rebind, make_binary,
+                       make_float_unary, make_unary, normalize_axis, prep_binary)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+import jax.numpy as jnp  # noqa: E402
+import jax  # noqa: E402
+
+# -- binary arithmetic -------------------------------------------------------
+add = make_binary("add", jnp.add)
+subtract = make_binary("subtract", jnp.subtract)
+multiply = make_binary("multiply", jnp.multiply)
+divide = make_binary("divide", jnp.true_divide, float_only=True)
+floor_divide = make_binary("floor_divide", jnp.floor_divide)
+remainder = make_binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = make_binary("maximum", jnp.maximum)
+minimum = make_binary("minimum", jnp.minimum)
+fmax = make_binary("fmax", jnp.fmax)
+fmin = make_binary("fmin", jnp.fmin)
+atan2 = make_binary("atan2", jnp.arctan2, float_only=True)
+hypot = make_binary("hypot", jnp.hypot, float_only=True)
+logaddexp = make_binary("logaddexp", jnp.logaddexp, float_only=True)
+nextafter = make_binary("nextafter", jnp.nextafter)
+copysign = make_binary("copysign", jnp.copysign)
+heaviside = make_binary("heaviside", jnp.heaviside)
+gcd = make_binary("gcd", jnp.gcd)
+lcm = make_binary("lcm", jnp.lcm)
+inner = make_binary("inner_elem", jnp.inner)
+
+
+def pow(x, y, name=None):
+    x_t = as_tensor(x) if not isinstance(x, Tensor) else x
+    if isinstance(y, (int, float)) and not isinstance(y, bool):
+        opname = "pow_scalar"
+        if opname not in dispatch.op_registry():
+            dispatch.register_op(opname, lambda a, *, exp: jnp.power(a, exp))
+        return dispatch.apply(opname, [x_t], {"exp": y})
+    x2, y2 = prep_binary(x, y)
+    if "elementwise_pow" not in dispatch.op_registry():
+        dispatch.register_op("elementwise_pow", jnp.power)
+    return dispatch.apply("elementwise_pow", [x2, y2])
+
+
+# -- unary -------------------------------------------------------------------
+exp = make_float_unary("exp", jnp.exp)
+expm1 = make_float_unary("expm1", jnp.expm1)
+log = make_float_unary("log", jnp.log)
+log1p = make_float_unary("log1p", jnp.log1p)
+log2 = make_float_unary("log2", jnp.log2)
+log10 = make_float_unary("log10", jnp.log10)
+sqrt = make_float_unary("sqrt", jnp.sqrt)
+rsqrt = make_float_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+abs = make_unary("abs", jnp.abs)
+absolute = abs
+sign = make_unary("sign", jnp.sign)
+floor = make_unary("floor", jnp.floor)
+ceil = make_unary("ceil", jnp.ceil)
+# paddle rounds half away from zero (phi RoundFunctor = std::round), unlike
+# numpy/jax half-to-even; decimals shifts by 10^n first.
+dispatch.register_op("round", lambda x, *, decimals: _round_away(x, decimals))
+
+
+def _round_away(x, decimals):
+    if decimals:
+        f = 10.0 ** decimals
+        return jnp.trunc(jnp.abs(x * f) + 0.5) * jnp.sign(x) / f
+    return jnp.trunc(jnp.abs(x) + 0.5) * jnp.sign(x)
+
+
+def round(x, decimals=0, name=None):
+    return dispatch.apply("round", [as_tensor(x)], {"decimals": int(decimals)})
+trunc = make_unary("trunc", jnp.trunc)
+frac = make_unary("frac", lambda x: x - jnp.trunc(x))
+square = make_unary("square", jnp.square)
+reciprocal = make_float_unary("reciprocal", jnp.reciprocal)
+neg = make_unary("neg", jnp.negative)
+sin = make_float_unary("sin", jnp.sin)
+cos = make_float_unary("cos", jnp.cos)
+tan = make_float_unary("tan", jnp.tan)
+asin = make_float_unary("asin", jnp.arcsin)
+acos = make_float_unary("acos", jnp.arccos)
+atan = make_float_unary("atan", jnp.arctan)
+sinh = make_float_unary("sinh", jnp.sinh)
+cosh = make_float_unary("cosh", jnp.cosh)
+tanh = make_float_unary("tanh", jnp.tanh)
+asinh = make_float_unary("asinh", jnp.arcsinh)
+acosh = make_float_unary("acosh", jnp.arccosh)
+atanh = make_float_unary("atanh", jnp.arctanh)
+erf = make_float_unary("erf", jax.scipy.special.erf)
+erfinv = make_float_unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = make_float_unary("sigmoid", jax.nn.sigmoid)
+digamma = make_float_unary("digamma", jax.scipy.special.digamma)
+lgamma = make_float_unary("lgamma", jax.scipy.special.gammaln)
+i0 = make_float_unary("i0", jax.scipy.special.i0)
+i1 = make_float_unary("i1", jax.scipy.special.i1)
+angle = make_unary("angle", jnp.angle)
+conj = make_unary("conj", jnp.conj)
+real = make_unary("real", jnp.real)
+imag = make_unary("imag", jnp.imag)
+deg2rad = make_float_unary("deg2rad", jnp.deg2rad)
+rad2deg = make_float_unary("rad2deg", jnp.rad2deg)
+
+isnan = make_unary("isnan", jnp.isnan)
+isinf = make_unary("isinf", jnp.isinf)
+isfinite = make_unary("isfinite", jnp.isfinite)
+
+
+# -- scale / clip / lerp -----------------------------------------------------
+dispatch.register_op(
+    "scale", lambda x, *, scale, bias, bias_after_scale:
+    x * scale + bias if bias_after_scale else (x + bias) * scale)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = dispatch.apply("scale", [as_tensor(x)],
+                         {"scale": float(scale), "bias": float(bias),
+                          "bias_after_scale": bool(bias_after_scale)})
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+dispatch.register_op("clip", lambda x, lo, hi: jnp.clip(x, lo, hi))
+dispatch.register_op("clip_min", lambda x, lo: jnp.maximum(x, lo))
+dispatch.register_op("clip_max", lambda x, hi: jnp.minimum(x, hi))
+
+
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    if min is not None and max is not None:
+        _, lo = prep_binary(x, min)
+        _, hi = prep_binary(x, max)
+        return dispatch.apply("clip", [x, lo, hi])
+    if min is not None:
+        _, lo = prep_binary(x, min)
+        return dispatch.apply("clip_min", [x, lo])
+    if max is not None:
+        _, hi = prep_binary(x, max)
+        return dispatch.apply("clip_max", [x, hi])
+    return x
+
+
+dispatch.register_op("lerp", lambda x, y, w: x + w * (y - x))
+
+
+def lerp(x, y, weight, name=None):
+    x, y = prep_binary(x, y)
+    if not isinstance(weight, Tensor):
+        weight = as_tensor(float(weight), dtype=x.dtype)
+    return dispatch.apply("lerp", [x, y, weight])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    if "stanh" not in dispatch.op_registry():
+        dispatch.register_op("stanh", lambda x, *, a, b: b * jnp.tanh(a * x))
+    return dispatch.apply("stanh", [as_tensor(x)], {"a": float(scale_a), "b": float(scale_b)})
+
+
+# -- cumulative --------------------------------------------------------------
+dispatch.register_op("cumsum", lambda x, *, axis: jnp.cumsum(x, axis=axis))
+dispatch.register_op("cumsum_flat", lambda x: jnp.cumsum(x.reshape(-1)))
+dispatch.register_op("cumprod", lambda x, *, axis: jnp.cumprod(x, axis=axis))
+dispatch.register_op("cummax", lambda x, *, axis: jax.lax.cummax(x, axis=axis), multi_out=False)
+dispatch.register_op("cummin", lambda x, *, axis: jax.lax.cummin(x, axis=axis), multi_out=False)
+dispatch.register_op("logcumsumexp", lambda x, *, axis: jax.lax.associative_scan(
+    jnp.logaddexp, x, axis=axis))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    if axis is None:
+        return dispatch.apply("cumsum_flat", [x])
+    return dispatch.apply("cumsum", [x], {"axis": normalize_axis(axis, x.ndim)})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return dispatch.apply("cumprod", [x], {"axis": normalize_axis(dim, x.ndim)})
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if axis is None:
+        from .manipulation import reshape
+
+        x = reshape(x, [-1])
+        axis = 0
+    return dispatch.apply("logcumsumexp", [x], {"axis": normalize_axis(axis, x.ndim)})
+
+
+# -- misc --------------------------------------------------------------------
+dispatch.register_op("addmm", lambda inp, x, y, *, alpha, beta:
+                     beta * inp + alpha * (x @ y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch.apply("addmm", [as_tensor(input), as_tensor(x), as_tensor(y)],
+                          {"alpha": float(alpha), "beta": float(beta)})
+
+
+dispatch.register_op("outer", lambda x, y: jnp.outer(x, y))
+
+
+def outer(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("outer", [x, y])
+
+
+def inner_product(x, y, name=None):
+    x, y = prep_binary(x, y)
+    if "inner_prod" not in dispatch.op_registry():
+        dispatch.register_op("inner_prod", jnp.inner)
+    return dispatch.apply("inner_prod", [x, y])
+
+
+dispatch.register_op("kron", jnp.kron)
+
+
+def kron(x, y, name=None):
+    x, y = prep_binary(x, y)
+    return dispatch.apply("kron", [x, y])
+
+
+dispatch.register_op("diff_op", lambda x, *, n, axis: jnp.diff(x, n=n, axis=axis))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    if prepend is not None or append is not None:
+        from .manipulation import concat
+
+        parts = []
+        if prepend is not None:
+            parts.append(as_tensor(prepend))
+        parts.append(x)
+        if append is not None:
+            parts.append(as_tensor(append))
+        x = concat(parts, axis=axis)
+    return dispatch.apply("diff_op", [x], {"n": int(n), "axis": int(axis)})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    if "nan_to_num" not in dispatch.op_registry():
+        dispatch.register_op("nan_to_num", lambda x, *, nan, posinf, neginf:
+                             jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+    return dispatch.apply("nan_to_num", [as_tensor(x)],
+                          {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+def multiply_(x, y):
+    out = multiply(x, y)
+    return inplace_rebind(x, out)
+
+
+def add_(x, y):
+    out = add(x, y)
+    return inplace_rebind(x, out)
+
+
+def subtract_(x, y):
+    out = subtract(x, y)
+    return inplace_rebind(x, out)
+
+
+def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = scale(x, scale_v, bias, bias_after_scale, act)
+    return inplace_rebind(x, out)
+
+
+def clip_(x, min=None, max=None):
+    out = clip(x, min, max)
+    return inplace_rebind(x, out)

@@ -1,0 +1,171 @@
+"""Reduction ops (reference: paddle/phi/kernels/*reduce*, python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ._helpers import as_tensor, normalize_axis
+
+
+def _reg(name, fn):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn)
+
+
+_reg("reduce_sum", lambda x, *, axis, keepdim: jnp.sum(x, axis=axis, keepdims=keepdim))
+_reg("reduce_mean", lambda x, *, axis, keepdim: jnp.mean(x, axis=axis, keepdims=keepdim))
+_reg("reduce_max", lambda x, *, axis, keepdim: jnp.max(x, axis=axis, keepdims=keepdim))
+_reg("reduce_min", lambda x, *, axis, keepdim: jnp.min(x, axis=axis, keepdims=keepdim))
+_reg("reduce_prod", lambda x, *, axis, keepdim: jnp.prod(x, axis=axis, keepdims=keepdim))
+_reg("reduce_all", lambda x, *, axis, keepdim: jnp.all(x, axis=axis, keepdims=keepdim))
+_reg("reduce_any", lambda x, *, axis, keepdim: jnp.any(x, axis=axis, keepdims=keepdim))
+_reg("argmax", lambda x, *, axis, keepdim, dtype: jnp.argmax(
+    x, axis=axis, keepdims=keepdim).astype(np.dtype(dtype)))
+_reg("argmin", lambda x, *, axis, keepdim, dtype: jnp.argmin(
+    x, axis=axis, keepdims=keepdim).astype(np.dtype(dtype)))
+_reg("logsumexp", lambda x, *, axis, keepdim: jax.scipy.special.logsumexp(
+    x, axis=axis, keepdims=keepdim))
+_reg("reduce_std", lambda x, *, axis, keepdim, ddof: jnp.std(
+    x, axis=axis, keepdims=keepdim, ddof=ddof))
+_reg("reduce_var", lambda x, *, axis, keepdim, ddof: jnp.var(
+    x, axis=axis, keepdims=keepdim, ddof=ddof))
+_reg("nanmean", lambda x, *, axis, keepdim: jnp.nanmean(x, axis=axis, keepdims=keepdim))
+_reg("nansum", lambda x, *, axis, keepdim: jnp.nansum(x, axis=axis, keepdims=keepdim))
+_reg("median_op", lambda x, *, axis, keepdim: jnp.median(x, axis=axis, keepdims=keepdim))
+_reg("nanmedian_op", lambda x, *, axis, keepdim: jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+_reg("quantile_op", lambda x, *, q, axis, keepdim: jnp.quantile(
+    x, jnp.asarray(q), axis=axis, keepdims=keepdim))
+_reg("count_nonzero", lambda x, *, axis, keepdim: jnp.count_nonzero(
+    x, axis=axis, keepdims=keepdim).astype(np.int64))
+
+
+def _reduce(opname, x, axis, keepdim, extra=None, cast_int_to=None):
+    x = as_tensor(x)
+    if cast_int_to is not None and not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        from .manipulation import cast
+
+        x = cast(x, cast_int_to)
+    attrs = {"axis": normalize_axis(axis, x.ndim), "keepdim": bool(keepdim)}
+    if extra:
+        attrs.update(extra)
+    return dispatch.apply(opname, [x], attrs)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    elif x.dtype == dtype_mod.bool_:
+        from .manipulation import cast
+
+        x = cast(x, "int64")
+    return _reduce("reduce_sum", x, axis, keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_mean", x, axis, keepdim,
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_max", x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_min", x, axis, keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_max", x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_min", x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return _reduce("reduce_prod", x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_all", x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_any", x, axis, keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    axis_n = normalize_axis(axis, x.ndim)
+    return dispatch.apply("argmax", [x], {"axis": axis_n, "keepdim": bool(keepdim),
+                                          "dtype": np.dtype(dtype_mod.to_np(dtype)).name})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    axis_n = normalize_axis(axis, x.ndim)
+    return dispatch.apply("argmin", [x], {"axis": axis_n, "keepdim": bool(keepdim),
+                                          "dtype": np.dtype(dtype_mod.to_np(dtype)).name})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _reduce("logsumexp", x, axis, keepdim,
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _reduce("reduce_std", x, axis, keepdim, {"ddof": 1 if unbiased else 0},
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _reduce("reduce_var", x, axis, keepdim, {"ddof": 1 if unbiased else 0},
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce("nanmean", x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return _reduce("nansum", x, axis, keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _reduce("median_op", x, axis, keepdim,
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _reduce("nanmedian_op", x, axis, keepdim,
+                   cast_int_to=dtype_mod.get_default_dtype())
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    qv = tuple(q) if isinstance(q, (list, tuple)) else float(q)
+    return dispatch.apply("quantile_op", [x],
+                          {"q": qv, "axis": normalize_axis(axis, x.ndim),
+                           "keepdim": bool(keepdim)})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _reduce("count_nonzero", x, axis, keepdim)

@@ -1,0 +1,26 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+The reference's distributed tests need real GPUs (SURVEY.md §4); the TPU build tests
+sharding on XLA:CPU with `--xla_force_host_platform_device_count=8` for free.
+"""
+import os
+
+# Must be set before jax initializes (force: the outer env may point at a TPU).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) re-forces its own platform; override it.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,147 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x  # 4
+    z = y * x  # 8 = x^3 -> dz/dx = 3x^2 = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_branching_accumulation():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 4
+    out = a + b
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+
+def test_matmul_grad():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(4, 5).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a_np.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    out = (x * y).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_backward_through_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    out = (a * 2).sum() + (b * 3).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [2.0, 4.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_paddle_grad_nonleaf():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * 3
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, h, retain_graph=True)
+    np.testing.assert_allclose(gh.numpy(), [6.0, 12.0])
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_int_output_op_no_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    i = paddle.argmax(x)
+    assert i.stop_gradient
+    # mixed pipeline: argmax result used for gather, grads still flow to x via gather
+    g = paddle.gather(x, paddle.to_tensor([0, 1]))
+    g.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0, 0.0])
+
+
+def test_reduction_grads():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    paddle.mean(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 3), 1 / 6))
+
+
+def test_softmax_ce_style_grad():
+    logits = paddle.to_tensor(np.random.randn(4, 10).astype(np.float32),
+                              stop_gradient=False)
+    p = paddle.nn_functional_softmax_probe(logits) if hasattr(
+        paddle, "nn_functional_softmax_probe") else paddle.ops.activation.softmax(logits)
+    loss = -(paddle.log(p + 1e-9)[:, 0]).mean()
+    loss.backward()
+    assert logits.grad is not None
+    assert np.isfinite(logits.grad.numpy()).all()

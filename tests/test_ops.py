@@ -1,0 +1,164 @@
+"""OpTest-style numeric checks vs numpy (reference harness: test/legacy_test/op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def check(pd_out, np_out, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy(), np.float64),
+                               np.asarray(np_out, np.float64), rtol=rtol, atol=atol)
+
+
+@pytest.fixture
+def x(rng):
+    return rng.standard_normal((3, 4)).astype(np.float32)
+
+
+def test_unary_suite(x):
+    t = paddle.to_tensor(x)
+    check(paddle.exp(t), np.exp(x))
+    check(paddle.tanh(t), np.tanh(x))
+    check(paddle.abs(t), np.abs(x))
+    check(paddle.sigmoid(t), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    check(paddle.sqrt(paddle.abs(t)), np.sqrt(np.abs(x)))
+    check(paddle.floor(t), np.floor(x))
+    check(paddle.square(t), x * x)
+
+
+def test_reductions(x):
+    t = paddle.to_tensor(x)
+    check(paddle.sum(t), x.sum())
+    check(paddle.sum(t, axis=1), x.sum(1))
+    check(paddle.mean(t, axis=0, keepdim=True), x.mean(0, keepdims=True))
+    check(paddle.max(t, axis=1), x.max(1))
+    check(paddle.std(t), x.std(ddof=1), rtol=1e-4)
+    check(paddle.logsumexp(t), np.log(np.exp(x.astype(np.float64)).sum()), rtol=1e-5)
+    assert paddle.argmax(t).dtype == paddle.int64
+
+
+def test_manipulation(x):
+    t = paddle.to_tensor(x)
+    check(paddle.reshape(t, [4, 3]), x.reshape(4, 3))
+    check(paddle.transpose(t, [1, 0]), x.T)
+    check(paddle.flatten(t), x.reshape(-1))
+    check(paddle.concat([t, t], axis=0), np.concatenate([x, x], 0))
+    check(paddle.stack([t, t], axis=0), np.stack([x, x], 0))
+    parts = paddle.split(t, 2, axis=1)
+    assert len(parts) == 2
+    check(parts[0], x[:, :2])
+    check(paddle.squeeze(paddle.unsqueeze(t, 0), 0), x)
+    check(paddle.tile(t, [2, 1]), np.tile(x, (2, 1)))
+    check(paddle.flip(t, 0), x[::-1])
+    check(paddle.roll(t, 1, 0), np.roll(x, 1, 0))
+    check(paddle.broadcast_to(paddle.to_tensor(x[0]), [3, 4]),
+          np.broadcast_to(x[0], (3, 4)))
+
+
+def test_gather_scatter():
+    x = np.arange(10, dtype=np.float32)
+    t = paddle.to_tensor(x)
+    idx = paddle.to_tensor([1, 3, 5])
+    check(paddle.gather(t, idx), x[[1, 3, 5]])
+    upd = paddle.to_tensor([10.0, 20.0, 30.0])
+    out = paddle.scatter(t, idx, upd)
+    exp = x.copy()
+    exp[[1, 3, 5]] = [10, 20, 30]
+    check(out, exp)
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], np.float32)
+    t = paddle.to_tensor(x)
+    v, i = paddle.topk(t, 2)
+    check(v, np.array([[3, 2], [9, 8]]))
+    assert i.numpy().tolist() == [[0, 2], [0, 2]]
+    check(paddle.sort(t, axis=1), np.sort(x, 1))
+    assert paddle.argsort(t, axis=1).numpy().tolist() == [[1, 2, 0], [1, 2, 0]]
+
+
+def test_where_masked():
+    x = np.array([1.0, -2.0, 3.0], np.float32)
+    t = paddle.to_tensor(x)
+    out = paddle.where(t > 0, t, paddle.zeros_like(t))
+    check(out, np.where(x > 0, x, 0))
+    check(paddle.masked_select(t, t > 0), x[x > 0])
+
+
+def test_linalg(x):
+    t = paddle.to_tensor(x)
+    w = paddle.to_tensor(x.T.copy())
+    check(paddle.matmul(t, w), x @ x.T, rtol=1e-4)
+    check(paddle.t(t), x.T)
+    check(paddle.norm(t), np.linalg.norm(x), rtol=1e-4)
+    a = np.random.randn(4, 4).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    ta = paddle.to_tensor(a)
+    check(paddle.inverse(ta), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+    check(paddle.det(ta), np.linalg.det(a), rtol=1e-3)
+    chol = paddle.cholesky(ta)
+    check(paddle.matmul(chol, paddle.t(chol)), a, rtol=1e-4, atol=1e-4)
+
+
+def test_einsum(x):
+    t = paddle.to_tensor(x)
+    check(paddle.einsum("ij->ji", t), x.T)
+    check(paddle.einsum("ij,kj->ik", t, t), x @ x.T, rtol=1e-4)
+
+
+def test_activations(x):
+    t = paddle.to_tensor(x)
+    check(paddle.relu(t), np.maximum(x, 0))
+    check(paddle.softmax(t, axis=-1),
+          np.exp(x) / np.exp(x).sum(-1, keepdims=True), rtol=1e-4)
+    g = paddle.gelu(t).numpy()
+    assert g.shape == x.shape
+    check(paddle.leaky_relu(t, 0.1), np.where(x > 0, x, 0.1 * x))
+
+
+def test_cumsum_cumprod():
+    x = np.arange(1, 7, dtype=np.float32).reshape(2, 3)
+    t = paddle.to_tensor(x)
+    check(paddle.cumsum(t, axis=1), np.cumsum(x, 1))
+    check(paddle.cumprod(t, dim=1), np.cumprod(x, 1))
+    check(paddle.cumsum(t), np.cumsum(x))
+
+
+def test_pad():
+    x = np.ones((1, 1, 2, 2), np.float32)
+    t = paddle.to_tensor(x)
+    out = paddle.pad(t, [1, 1, 1, 1])
+    assert out.shape == [1, 1, 4, 4]
+    assert out.numpy()[0, 0, 0, 0] == 0
+
+
+def test_clip_scale():
+    x = np.array([-2.0, 0.5, 3.0], np.float32)
+    t = paddle.to_tensor(x)
+    check(paddle.clip(t, -1, 1), np.clip(x, -1, 1))
+    check(paddle.scale(t, 2.0, 1.0), x * 2 + 1)
+
+
+def test_unique_nonzero():
+    x = np.array([1, 2, 2, 3, 0], np.int64)
+    t = paddle.to_tensor(x)
+    assert paddle.unique(t).numpy().tolist() == [0, 1, 2, 3]
+    nz = paddle.nonzero(t)
+    assert nz.numpy().reshape(-1).tolist() == [0, 1, 2, 3]
+
+
+def test_one_hot_take_along():
+    idx = paddle.to_tensor([0, 2])
+    oh = paddle.one_hot(idx, 3)
+    assert oh.numpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    x = paddle.to_tensor(np.arange(6, np.float32).reshape(2, 3)
+                         if False else np.arange(6, dtype=np.float32).reshape(2, 3))
+    ta = paddle.take_along_axis(x, paddle.to_tensor([[0], [2]]), axis=1)
+    assert ta.numpy().reshape(-1).tolist() == [0.0, 5.0]
+
+
+def test_bf16_matmul():
+    a = paddle.ones([4, 4], dtype="bfloat16")
+    out = paddle.matmul(a, a)
+    assert out.dtype == paddle.bfloat16
+    assert out.numpy().astype(np.float32)[0, 0] == 4.0

@@ -1,0 +1,158 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.stop_gradient is True
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_int_dtype_default():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == paddle.int64
+
+
+def test_scalar_item():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+
+
+def test_arith_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a + 1).numpy(), [2, 3])
+    np.testing.assert_allclose((1 + a).numpy(), [2, 3])
+    np.testing.assert_allclose((2 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+
+
+def test_scalar_promotion():
+    a = paddle.to_tensor([1, 2])  # int64
+    out = a + 0.5
+    assert out.dtype == paddle.float32
+    out2 = a + 1
+    assert out2.dtype == paddle.int64
+
+
+def test_mixed_dtype_promotion():
+    a = paddle.to_tensor([1, 2])  # int64
+    b = paddle.to_tensor([1.0, 2.0])  # float32
+    assert (a + b).dtype == paddle.float32
+    # divide always yields float
+    assert (a / paddle.to_tensor([2, 2])).dtype == paddle.float32
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(t[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(t[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    t[0, 0] = 100.0
+    assert t.numpy()[0, 0] == 100.0
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_bool_mask_getitem():
+    t = paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+    mask = t > 2
+    out = t[mask]
+    np.testing.assert_allclose(out.numpy(), [3, 4])
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.5, 2.5])
+    i = t.astype("int32")
+    assert i.dtype == paddle.int32
+    b = t.astype(paddle.bfloat16)
+    assert b.dtype == paddle.bfloat16
+
+
+def test_detach_and_stop_gradient():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    assert not t.stop_gradient
+
+
+def test_repr_runs():
+    t = paddle.ones([2, 2])
+    assert "Tensor" in repr(t)
+
+
+def test_compare_ops():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert (a == a).all().item()
+    assert bool((a < 2).numpy()[0])
+    assert paddle.equal_all(a, a).item()
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == paddle.int32
+    assert paddle.full([2], 7).numpy().tolist() == [7.0, 7.0]
+    assert paddle.arange(5).dtype == paddle.int64
+    assert paddle.arange(0, 1, 0.25).shape == [4]
+    assert paddle.eye(3).numpy()[1][1] == 1
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), [0, .25, .5, .75, 1])
+    t = paddle.rand([4, 4])
+    assert t.shape == [4, 4]
+    assert paddle.randn([10]).dtype == paddle.float32
+    r = paddle.randint(0, 5, [100])
+    assert int(r.numpy().max()) < 5
+
+
+def test_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4])
+    paddle.seed(42)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_inplace_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 10.0
+    loss = y.sum()
+    loss.backward()
+    # grad of x: d(sum)/dx = 2 except slot 0 overwritten -> 0
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_round_half_away_from_zero():
+    t = paddle.to_tensor([0.5, 1.5, 2.5, -0.5, -2.5])
+    assert paddle.round(t).numpy().tolist() == [1.0, 2.0, 3.0, -1.0, -3.0]
+    t2 = paddle.to_tensor([1.25, -1.25])
+    assert paddle.round(t2, decimals=1).numpy().tolist() == [1.3, -1.3] or \
+        np.allclose(paddle.round(t2, decimals=1).numpy(), [1.3, -1.3], atol=1e-6)
+
+
+def test_inplace_on_leaf_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(1.0)
+    with paddle.no_grad():
+        x.add_(1.0)  # allowed under no_grad (optimizer pattern)
+    assert x.numpy().tolist() == [2.0]
+
+
+def test_nonscalar_backward_fills_ones():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_uint_dtypes():
+    t = paddle.Tensor(np.zeros(2, dtype=np.uint16))
+    assert t.dtype.name == "uint16"
