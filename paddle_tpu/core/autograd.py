@@ -147,7 +147,9 @@ class OpGradNode(GradNodeBase):
         for i, ct in enumerate(cotangents):
             if ct is None:
                 shape, dt = self.out_avals[i]
-                if np.issubdtype(dt, np.inexact):
+                from ..framework.dtype import is_inexact_np
+
+                if is_inexact_np(dt):
                     cts.append(np.zeros(shape, dt))
                 else:
                     cts.append(np.zeros(shape, jax.dtypes.float0))

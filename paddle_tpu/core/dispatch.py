@@ -192,7 +192,9 @@ def _vjp_caller():
 
 
 def _differentiable(a) -> bool:
-    return a is not None and np.issubdtype(np.dtype(a.dtype), np.inexact)
+    from ..framework.dtype import is_inexact_np
+
+    return a is not None and is_inexact_np(a.dtype)
 
 
 def apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
@@ -287,7 +289,9 @@ def _maybe_check_nan_inf(name, tensors):
 
     for t in tensors:
         d = t._data
-        if np.issubdtype(np.dtype(d.dtype), np.inexact):
+        from ..framework.dtype import is_inexact_np
+
+        if is_inexact_np(d.dtype):
             bad = bool(jnp.logical_not(jnp.isfinite(d)).any())
             if bad:
                 msg = f"Op {name} produced NaN/Inf in output {t.shape}"

@@ -128,6 +128,21 @@ def to_np(dtype) -> np.dtype:
     return convert_dtype(dtype).np_dtype
 
 
+# bf16/fp8 are numpy *extension* dtypes (kind 'V'), invisible to np.issubdtype —
+# every float/inexact check in the framework must go through these helpers.
+_EXT_FLOAT_NAMES = ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
+def is_floating_np(dt) -> bool:
+    dt = np.dtype(dt)
+    return np.issubdtype(dt, np.floating) or dt.name in _EXT_FLOAT_NAMES
+
+
+def is_inexact_np(dt) -> bool:
+    dt = np.dtype(dt)
+    return np.issubdtype(dt, np.inexact) or dt.name in _EXT_FLOAT_NAMES
+
+
 # paddle-style default dtype state (reference: python/paddle/base/framework.py
 # set_default_dtype/get_default_dtype)
 _default_dtype = float32

@@ -1,0 +1,8 @@
+"""paddle.nn analog: Layer system, layers, functional, initializers, clip."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue)
+from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer  # noqa: F401

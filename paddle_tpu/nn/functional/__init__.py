@@ -1,0 +1,34 @@
+"""paddle.nn.functional analog — activations re-exported from the ops library plus
+conv/pool/norm/loss/common/attention functionals."""
+from ...ops.activation import (celu, elu, gelu, hardshrink, hardsigmoid,  # noqa: F401
+                               hardswish, hardtanh, leaky_relu, log_sigmoid,
+                               log_softmax, maxout, mish, prelu, relu, relu6,
+                               rrelu, selu, sigmoid, silu, softmax, softplus,
+                               softshrink, softsign, swiglu, swish, tanhshrink,
+                               thresholded_relu)
+from ...ops.math import tanh  # noqa: F401
+from ...ops.manipulation import one_hot  # noqa: F401
+from .common import (alpha_dropout, bilinear, channel_shuffle,  # noqa: F401
+                     class_center_sample, cosine_similarity, dropout, dropout2d,
+                     dropout3d, embedding, fold, glu, interpolate, label_smooth,
+                     linear, pad, pixel_shuffle, pixel_unshuffle, unfold,
+                     upsample)
+from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose,  # noqa: F401
+                   conv3d, conv3d_transpose)
+from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
+                      adaptive_avg_pool3d, adaptive_max_pool1d,
+                      adaptive_max_pool2d, adaptive_max_pool3d, avg_pool1d,
+                      avg_pool2d, avg_pool3d, lp_pool1d, lp_pool2d, max_pool1d,
+                      max_pool2d, max_pool3d, max_unpool2d)
+from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
+                   local_response_norm, normalize, rms_norm)
+from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # noqa: F401
+                   cosine_embedding_loss, cross_entropy, ctc_loss,
+                   gaussian_nll_loss, hinge_embedding_loss, kl_div, l1_loss,
+                   log_loss, margin_ranking_loss, mse_loss,
+                   multi_label_soft_margin_loss, nll_loss, poisson_nll_loss,
+                   sigmoid_focal_loss, smooth_l1_loss, soft_margin_loss,
+                   softmax_with_cross_entropy, square_error_cost,
+                   triplet_margin_loss)
+from .attention import (flash_attention, flash_attn_unpadded,  # noqa: F401
+                        scaled_dot_product_attention, sdp_kernel)

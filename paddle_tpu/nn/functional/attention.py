@@ -1,0 +1,195 @@
+"""Attention functionals: scaled_dot_product_attention / flash_attention.
+
+Analog of `python/paddle/nn/functional/flash_attention.py` (flash_attention:195,
+sdp selector :148). The reference binds the flashattn CUDA library
+(`phi/kernels/gpu/flash_attn_kernel.cu`); the TPU path prefers a Pallas
+flash-attention kernel (`paddle_tpu.ops.pallas.flash_attention`) and falls back to
+a blockwise-stable XLA composite that the compiler fuses.
+
+Layout note: paddle flash_attention uses [batch, seqlen, nheads, head_dim].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+_sdp_backend = {"flash": True, "mem_efficient": True, "math": True}
+
+
+def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
+    """Context manager mirroring paddle's sdp backend selector (:148)."""
+
+    class _Ctx:
+        def __enter__(self):
+            self._prev = dict(_sdp_backend)
+            _sdp_backend.update(flash=enable_flash, math=enable_math,
+                                mem_efficient=enable_mem_efficient)
+
+        def __exit__(self, *a):
+            _sdp_backend.update(self._prev)
+            return False
+
+    return _Ctx()
+
+
+def _sdpa_fn(q, k, v, mask, causal, scale, is_bnsd):
+    """Reference math path. q/k/v: [B, S, H, D] (paddle layout) unless is_bnsd."""
+    import jax
+    import jax.numpy as jnp
+
+    if not is_bnsd:
+        q = jnp.swapaxes(q, 1, 2)  # -> [B, H, S, D]
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    # accumulate scores in f32 (MXU-native: bf16 inputs, f32 accumulation)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    sq, skv = q.shape[2], k.shape[2]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        scores = jnp.where(causal_mask, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    if not is_bnsd:
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+dispatch.register_op("sdpa", lambda q, k, v, causal, scale, is_bnsd:
+                     _sdpa_fn(q, k, v, None, causal, scale, is_bnsd))
+dispatch.register_op("sdpa_mask", _sdpa_fn)
+
+
+def _try_pallas(q, k, v, causal):
+    """Use the Pallas flash kernel when on TPU and shapes allow it."""
+    if not _sdp_backend["flash"]:
+        return None
+    try:
+        from ...ops.pallas import flash_attention as pallas_fa
+    except Exception:
+        return None
+    return pallas_fa.maybe_flash(q, k, v, causal)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle.nn.functional.scaled_dot_product_attention ([B, S, H, D] layout)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    if attn_mask is None:
+        out = _try_pallas(q, k, v, is_causal)
+        if out is not None:
+            if dropout_p and training:
+                from . import common
+
+                out = common.dropout(out, p=dropout_p, training=training)
+            return out
+        out = dispatch.apply("sdpa", [q, k, v],
+                             {"causal": bool(is_causal), "scale": None,
+                              "is_bnsd": False})
+    else:
+        out = dispatch.apply("sdpa_mask", [q, k, v, as_tensor(attn_mask)],
+                             {"causal": bool(is_causal), "scale": None,
+                              "is_bnsd": False})
+    if dropout_p and training:
+        from . import common
+
+        out = common.dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention (:195).
+
+    Returns (out, softmax) — softmax is None unless return_softmax (reference
+    returns the softmax only in debug mode).
+    """
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training)
+    if return_softmax:
+        q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+        import jax.numpy as jnp
+
+        def probs_fn(q, k, v, causal):
+            import jax
+
+            qq = jnp.swapaxes(q, 1, 2)
+            kk = jnp.swapaxes(k, 1, 2)
+            scores = jnp.einsum("bhsd,bhtd->bhst", qq, kk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / np.sqrt(q.shape[-1])
+            if causal:
+                sq, skv = qq.shape[2], kk.shape[2]
+                m = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+                scores = jnp.where(m, scores, jnp.asarray(-1e30, scores.dtype))
+            return jax.nn.softmax(scores, axis=-1)
+
+        dispatch.register_op("fa_probs", probs_fn)
+        sm = dispatch.apply("fa_probs", [q, k, v], {"causal": bool(causal)})
+        return out, sm
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention: total-token packed layout [total, H, D].
+
+    Implemented by segment-masking the packed sequence (XLA composite); the
+    Pallas varlen kernel replaces this on TPU when available.
+    """
+    import jax.numpy as jnp
+
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    cq = as_tensor(cu_seqlens_q)
+    ck = as_tensor(cu_seqlens_k)
+
+    def fn(q, k, v, cq, ck, scale, causal):
+        import jax
+
+        tq = q.shape[0]
+        tk = k.shape[0]
+        d = q.shape[-1]
+        if scale is None:
+            scale = 1.0 / np.sqrt(d)
+        seg_q = jnp.searchsorted(cq[1:], jnp.arange(tq), side="right")
+        seg_k = jnp.searchsorted(ck[1:], jnp.arange(tk), side="right")
+        scores = jnp.einsum("qhd,khd->hqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        same = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(tk) - jnp.take(ck, seg_k)
+            same = same & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.where(same[None], scores, jnp.asarray(-1e30, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    dispatch.register_op("flash_attn_unpadded", fn)
+    out = dispatch.apply("flash_attn_unpadded", [q, k, v, cq, ck],
+                         {"scale": scale, "causal": bool(causal)})
+    if dropout and training:
+        from . import common
+
+        out = common.dropout(out, p=dropout, training=training)
+    return out, None

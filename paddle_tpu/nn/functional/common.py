@@ -1,0 +1,516 @@
+"""Common functional ops: linear, embedding, dropout, pad, interpolate, unfold...
+
+Analog of `python/paddle/nn/functional/common.py` + `input.py`. Each op is one
+registered composite JAX function (autograd comes from `jax.vjp` of the composite —
+the TPU analog of the reference's hand-written backward kernels).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...framework import random as random_mod
+from ...ops._helpers import as_tensor
+
+__all__ = ["linear", "embedding", "dropout", "dropout2d", "dropout3d",
+           "alpha_dropout", "pad", "interpolate", "upsample", "unfold", "fold",
+           "bilinear", "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+           "channel_shuffle", "label_smooth", "class_center_sample", "glu"]
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+def _linear_fn(x, w, b=None):
+    import jax.numpy as jnp
+
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+dispatch.register_op("linear", _linear_fn)
+dispatch.register_op("linear_nobias", lambda x, w: _linear_fn(x, w))
+
+
+def linear(x, weight, bias=None, name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    if bias is None:
+        return dispatch.apply("linear_nobias", [x, weight])
+    return dispatch.apply("linear", [x, weight, as_tensor(bias)])
+
+
+def _embedding_fn(ids, w, padding_idx):
+    import jax.numpy as jnp
+
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+dispatch.register_op("embedding", _embedding_fn)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    if padding_idx is not None:
+        padding_idx = int(padding_idx)
+        if padding_idx < 0:
+            padding_idx += int(weight.shape[0])
+    return dispatch.apply("embedding", [x, weight], {"padding_idx": padding_idx})
+
+
+# ---------------------------------------------------------------------------
+# dropout family — keys are passed as uint32 input arrays so the compiled
+# executable is reused across calls (no per-call recompilation).
+# ---------------------------------------------------------------------------
+
+def _raw_key():
+    import jax
+
+    return jax.random.key_data(random_mod.next_key())
+
+
+def _dropout_fn(x, raw_key, p, mode, axis):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.wrap_key_data(raw_key)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        scale = 1.0 / (1.0 - p) if p < 1.0 else 0.0
+        return jnp.where(keep, x * jnp.asarray(scale, x.dtype), jnp.zeros((), x.dtype))
+    # downscale_in_infer: train multiplies by mask only
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+dispatch.register_op("dropout", _dropout_fn)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = as_tensor(x)
+    p = float(p)
+    if not training:
+        if mode == "downscale_in_infer":
+            return x * (1.0 - p)
+        return x
+    if p == 0.0:
+        return x
+    if axis is not None and not isinstance(axis, int):
+        axis = tuple(int(a) for a in axis)
+    return dispatch.apply("dropout", [x, _raw_key()],
+                          {"p": p, "mode": mode, "axis": axis})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def _alpha_dropout_fn(x, raw_key, p):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.wrap_key_data(raw_key)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * alpha_p * p
+    y = jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
+    return (a * y + b).astype(x.dtype)
+
+
+dispatch.register_op("alpha_dropout", _alpha_dropout_fn)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    return dispatch.apply("alpha_dropout", [x, _raw_key()], {"p": float(p)})
+
+
+# ---------------------------------------------------------------------------
+# pad
+# ---------------------------------------------------------------------------
+
+def _pad_fn(x, pad, mode, value, data_format):
+    import jax.numpy as jnp
+
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form pad: [d0_lo, d0_hi, d1_lo, d1_hi, ...] paddle uses per-dim pairs
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form pads the trailing spatial dims (paddle NCHW semantics:
+        # pad is [w_lo, w_hi, h_lo, h_hi, ...] innermost-first)
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format and data_format.endswith("C"):  # NHWC-like: spatial before C
+            spatial_dims = list(range(1, 1 + n_spatial))
+        else:
+            spatial_dims = list(range(nd - n_spatial, nd))
+        for i, d in enumerate(reversed(spatial_dims)):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant",
+                       constant_values=jnp.asarray(value, x.dtype))
+    return jnp.pad(x, widths, mode=jmode)
+
+
+dispatch.register_op("nn_pad", _pad_fn)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None,
+        pad_from_left_axis=False):
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in pad.numpy().tolist()]
+    pad = tuple(int(v) for v in pad)
+    if len(pad) == 2 * x.ndim and pad_from_left_axis is False and mode == "constant":
+        # paddle full-form default is per-dim pairs starting from axis 0
+        pass
+    return dispatch.apply("nn_pad", [x],
+                          {"pad": pad, "mode": mode, "value": float(value),
+                           "data_format": data_format})
+
+
+# ---------------------------------------------------------------------------
+# interpolate / upsample
+# ---------------------------------------------------------------------------
+
+def _interp_fn(x, size, mode, align_corners, data_format):
+    """Per-dim interpolation matrices (exact align_corners semantics, and the
+    separable matmuls land on the MXU instead of gather kernels)."""
+    import jax
+    import jax.numpy as jnp
+
+    channel_last = data_format.endswith("C") and not data_format.startswith("NC")
+    nd = x.ndim - 2
+    spatial_axes = list(range(1, 1 + nd)) if channel_last else \
+        list(range(2, 2 + nd))
+    if mode == "bicubic":
+        # cubic via jax.image (half-pixel only; paddle's align_corners bicubic
+        # differs slightly at borders)
+        perm_in = (0,) + tuple(range(2, x.ndim)) + (1,)
+        xs = x if channel_last else x.transpose(perm_in)
+        out_shape = (xs.shape[0],) + tuple(size) + (xs.shape[-1],)
+        y = jax.image.resize(xs, out_shape, method="cubic")
+        if not channel_last:
+            y = y.transpose((0, x.ndim - 1) + tuple(range(1, x.ndim - 1)))
+        return y
+    y = x
+    for ax, out_s in zip(spatial_axes, size):
+        in_s = x.shape[ax]
+        if mode == "nearest":
+            if align_corners and out_s > 1:
+                src = np.round(np.arange(out_s) * (in_s - 1) /
+                               (out_s - 1)).astype(np.int32)
+            else:
+                src = np.floor(np.arange(out_s) * in_s / out_s).astype(np.int32)
+            y = jnp.take(y, jnp.asarray(src), axis=ax)
+            continue
+        m = np.zeros((in_s, out_s))
+        if mode == "area":
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = -(-((np.arange(out_s) + 1) * in_s) // out_s)
+            for i, (s, e) in enumerate(zip(starts, ends)):
+                m[s:e, i] = 1.0 / (e - s)
+        else:  # linear family
+            if align_corners and out_s > 1:
+                src = np.arange(out_s) * (in_s - 1) / (out_s - 1)
+            else:
+                src = (np.arange(out_s) + 0.5) * in_s / out_s - 0.5
+            src = np.clip(src, 0, in_s - 1)
+            i0 = np.floor(src).astype(np.int64)
+            i1 = np.minimum(i0 + 1, in_s - 1)
+            w1 = src - i0
+            for i in range(out_s):
+                m[i0[i], i] += 1 - w1[i]
+                m[i1[i], i] += w1[i]
+        mat = jnp.asarray(m, x.dtype)
+        y = jnp.moveaxis(jnp.tensordot(y, mat, axes=([ax], [0])), -1, ax)
+    return y
+
+
+dispatch.register_op("interpolate", _interp_fn)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    x = as_tensor(x)
+    nd = x.ndim - 2
+    if data_format is None:
+        data_format = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    channel_last = data_format.endswith("C")
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor must be set")
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    if isinstance(size, Tensor):
+        size = [int(v) for v in size.numpy().tolist()]
+    elif isinstance(size, (int, np.integer)):
+        size = [int(size)] * nd
+    size = tuple(int(getattr(s, "item", lambda: s)()) if not isinstance(s, int)
+                 else s for s in size)
+    return dispatch.apply("interpolate", [x],
+                          {"size": tuple(size), "mode": mode,
+                           "align_corners": bool(align_corners),
+                           "data_format": data_format})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+# ---------------------------------------------------------------------------
+# unfold / fold (im2col / col2im)
+# ---------------------------------------------------------------------------
+
+def _unfold_fn(x, kernel_sizes, strides, paddings, dilations):
+    import jax
+    import jax.numpy as jnp
+
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = paddings
+    dh, dw = dilations
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    out_h = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, out_h * out_w)
+
+
+dispatch.register_op("unfold", _unfold_fn)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = as_tensor(x)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    if len(pd) == 2:
+        pd = (pd[0], pd[1], pd[0], pd[1])
+    dl = _pair(dilations)
+    return dispatch.apply("unfold", [x], {"kernel_sizes": ks, "strides": st,
+                                          "paddings": pd, "dilations": dl})
+
+
+def _fold_fn(x, output_sizes, kernel_sizes, strides, paddings, dilations):
+    import jax.numpy as jnp
+
+    n, ckk, l = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = paddings
+    dh, dw = dilations
+    ph, pw = oh + ph0 + ph1, ow + pw0 + pw1
+    out_h = (ph - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, out_h, out_w)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi, wj = i * dh, j * dw
+            out = out.at[:, :, hi:hi + out_h * sh:sh, wj:wj + out_w * sw:sw].add(
+                cols[:, :, i, j])
+    return out[:, :, ph0:ph0 + oh, pw0:pw0 + ow]
+
+
+dispatch.register_op("fold", _fold_fn)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = as_tensor(x)
+    os_, ks = _pair(output_sizes), _pair(kernel_sizes)
+    st, pd, dl = _pair(strides), _pair(paddings), _pair(dilations)
+    if len(pd) == 2:
+        pd = (pd[0], pd[1], pd[0], pd[1])
+    return dispatch.apply("fold", [x], {"output_sizes": os_, "kernel_sizes": ks,
+                                        "strides": st, "paddings": pd,
+                                        "dilations": dl})
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def _bilinear_fn(x1, x2, w, b=None):
+    import jax.numpy as jnp
+
+    # w: [out, in1, in2]
+    y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if b is not None:
+        y = y + b
+    return y
+
+
+dispatch.register_op("bilinear", _bilinear_fn)
+dispatch.register_op("bilinear_nobias", lambda x1, x2, w: _bilinear_fn(x1, x2, w))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is None:
+        return dispatch.apply("bilinear_nobias",
+                              [as_tensor(x1), as_tensor(x2), as_tensor(weight)])
+    return dispatch.apply("bilinear", [as_tensor(x1), as_tensor(x2),
+                                       as_tensor(weight), as_tensor(bias)])
+
+
+def _cos_sim_fn(x1, x2, axis, eps):
+    import jax.numpy as jnp
+
+    dot = (x1 * x2).sum(axis=axis)
+    n1 = jnp.sqrt((x1 * x1).sum(axis=axis))
+    n2 = jnp.sqrt((x2 * x2).sum(axis=axis))
+    return dot / jnp.maximum(n1 * n2, jnp.asarray(eps, x1.dtype))
+
+
+dispatch.register_op("cosine_similarity", _cos_sim_fn)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return dispatch.apply("cosine_similarity", [as_tensor(x1), as_tensor(x2)],
+                          {"axis": int(axis), "eps": float(eps)})
+
+
+def _pixel_shuffle_fn(x, upscale_factor, data_format):
+    import jax.numpy as jnp
+
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+dispatch.register_op("pixel_shuffle", _pixel_shuffle_fn)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return dispatch.apply("pixel_shuffle", [as_tensor(x)],
+                          {"upscale_factor": int(upscale_factor),
+                           "data_format": data_format})
+
+
+def _pixel_unshuffle_fn(x, downscale_factor, data_format):
+    import jax.numpy as jnp
+
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+dispatch.register_op("pixel_unshuffle", _pixel_unshuffle_fn)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return dispatch.apply("pixel_unshuffle", [as_tensor(x)],
+                          {"downscale_factor": int(downscale_factor),
+                           "data_format": data_format})
+
+
+def _channel_shuffle_fn(x, groups, data_format):
+    n = x.shape[0]
+    if data_format == "NCHW":
+        c, h, w = x.shape[1:]
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = x.transpose(0, 2, 1, 3, 4)
+        return x.reshape(n, c, h, w)
+    h, w, c = x.shape[1:]
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(n, h, w, c)
+
+
+dispatch.register_op("channel_shuffle", _channel_shuffle_fn)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return dispatch.apply("channel_shuffle", [as_tensor(x)],
+                          {"groups": int(groups), "data_format": data_format})
+
+
+def _label_smooth_fn(label, prior_dist, epsilon):
+    import jax.numpy as jnp
+
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+dispatch.register_op("label_smooth",
+                     lambda label, epsilon: _label_smooth_fn(label, None, epsilon))
+dispatch.register_op("label_smooth_prior", _label_smooth_fn)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+    if prior_dist is None:
+        return dispatch.apply("label_smooth", [label], {"epsilon": float(epsilon)})
+    return dispatch.apply("label_smooth_prior", [label, as_tensor(prior_dist)],
+                          {"epsilon": float(epsilon)})
+
+
+def glu(x, axis=-1, name=None):
+    from ...ops import activation as act_ops
+
+    return act_ops.glu(x, axis=axis)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires the distributed margin-loss path; "
+        "use paddle_tpu.distributed margin_cross_entropy instead")

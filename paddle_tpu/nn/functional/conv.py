@@ -1,0 +1,240 @@
+"""Convolutions over `lax.conv_general_dilated` — the MXU path.
+
+Analog of `python/paddle/nn/functional/conv.py`. The reference routes conv to
+cuDNN (`paddle/phi/kernels/gpudnn/conv_kernel.cu`); on TPU convs lower straight to
+XLA convolution HLO which the compiler tiles onto the MXU, so there is exactly one
+composite op per conv variant and no algo-search autotuner.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        v = tuple(int(x) for x in v)
+        if len(v) == 1:
+            return v * n
+        return v
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n, data_format):
+    """Normalize paddle padding spec → lax padding (list of (lo, hi)) or str."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if all(isinstance(p, (list, tuple)) for p in padding):
+            return [tuple(int(x) for x in p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if len(padding) == 2 * (n + 2):  # per-dim pairs incl. batch/channel
+        if data_format.endswith("C"):
+            spatial = padding[2:2 + 2 * n]
+        else:
+            spatial = padding[4:4 + 2 * n]
+        return [(int(spatial[2 * i]), int(spatial[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding spec {padding}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_fn(x, w, b, stride, padding, dilation, groups, n, data_format):
+    import jax
+
+    channel_last = data_format.endswith("C")
+    dn = _dim_numbers(n, channel_last)
+    if channel_last:
+        # weight layout is paddle-style OI...; lax wants spatial...IO for NHWC
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = w.transpose(perm)
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=None)
+    if b is not None:
+        if channel_last:
+            y = y + b
+        else:
+            y = y + b.reshape((1, -1) + (1,) * n)
+    return y
+
+
+for _n in (1, 2, 3):
+    dispatch.register_op(
+        f"conv{_n}d",
+        (lambda n: lambda x, w, b, stride, padding, dilation, groups, data_format:
+         _conv_fn(x, w, b, stride, padding, dilation, groups, n, data_format))(_n))
+    dispatch.register_op(
+        f"conv{_n}d_nobias",
+        (lambda n: lambda x, w, stride, padding, dilation, groups, data_format:
+         _conv_fn(x, w, None, stride, padding, dilation, groups, n, data_format))(_n))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _tuple_n(stride, n)
+    dilation = _tuple_n(dilation, n)
+    pad_spec = _norm_padding(padding, n, data_format)
+    if isinstance(pad_spec, list):
+        pad_spec = tuple(tuple(p) for p in pad_spec)
+    attrs = {"stride": stride, "padding": pad_spec, "dilation": dilation,
+             "groups": int(groups), "data_format": data_format}
+    if bias is None:
+        return dispatch.apply(f"conv{n}d_nobias", [x, weight], attrs)
+    return dispatch.apply(f"conv{n}d", [x, weight, as_tensor(bias)], attrs)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    data_format = {"NCL": "NCW", "NLC": "NWC"}.get(data_format, data_format)
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+# ---------------------------------------------------------------------------
+# transposed conv
+# ---------------------------------------------------------------------------
+
+def _conv_transpose_fn(x, w, b, stride, padding, output_padding, dilation, groups,
+                       n, data_format):
+    import jax
+    import jax.numpy as jnp
+
+    channel_last = data_format.endswith("C")
+    dn = _dim_numbers(n, channel_last)
+    # paddle transposed-conv weight layout: [in_c, out_c/groups, *k]
+    # lax.conv_transpose with transpose_kernel=True wants IO...-style;
+    # build gradient-style conv: lhs_dilation = stride.
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        # SAME-style arithmetic: out = (in-1)*s - 2p + d*(k-1) + op + 1
+        k = w.shape[2:2 + n] if not channel_last else w.shape[2:2 + n]
+        pad = []
+        for i in range(n):
+            eff_k = dilation[i] * (w.shape[2 + i] - 1) + 1
+            lo = eff_k - 1 - padding[i][0]
+            hi = eff_k - 1 - padding[i][1] + output_padding[i]
+            pad.append((lo, hi))
+    if groups > 1:
+        ins = x.shape[1] if not channel_last else x.shape[-1]
+        xg = jnp.split(x, groups, axis=1 if not channel_last else -1)
+        wg = jnp.split(w, groups, axis=0)
+        outs = [_conv_transpose_single(xi, wi, pad, stride, dilation, n, channel_last)
+                for xi, wi in zip(xg, wg)]
+        y = jnp.concatenate(outs, axis=1 if not channel_last else -1)
+    else:
+        y = _conv_transpose_single(x, w, pad, stride, dilation, n, channel_last)
+    if b is not None:
+        y = y + (b if channel_last else b.reshape((1, -1) + (1,) * n))
+    return y
+
+
+def _conv_transpose_single(x, w, pad, stride, dilation, n, channel_last):
+    import jax
+
+    dn = _dim_numbers(n, channel_last)
+    # flip spatial dims + swap I/O: transposed conv == conv with lhs_dilation
+    w_flipped = jax.numpy.flip(w, axis=tuple(range(2, 2 + n)))
+    w_t = jax.numpy.swapaxes(w_flipped, 0, 1)  # [out_c, in_c, *k]
+    if channel_last:
+        w_t = w_t.transpose(tuple(range(2, 2 + n)) + (1, 0))
+    return jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * n, padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
+
+
+for _n in (1, 2, 3):
+    dispatch.register_op(
+        f"conv{_n}d_transpose",
+        (lambda n: lambda x, w, b, stride, padding, output_padding, dilation,
+         groups, data_format: _conv_transpose_fn(
+             x, w, b, stride, padding, output_padding, dilation, groups, n,
+             data_format))(_n))
+    dispatch.register_op(
+        f"conv{_n}d_transpose_nobias",
+        (lambda n: lambda x, w, stride, padding, output_padding, dilation,
+         groups, data_format: _conv_transpose_fn(
+             x, w, None, stride, padding, output_padding, dilation, groups, n,
+             data_format))(_n))
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _tuple_n(stride, n)
+    dilation = _tuple_n(dilation, n)
+    output_padding = _tuple_n(output_padding, n)
+    pad_spec = _norm_padding(padding, n, data_format)
+    if isinstance(pad_spec, list):
+        pad_spec = tuple(tuple(p) for p in pad_spec)
+    if output_size is not None:
+        # derive output_padding from requested size
+        output_size = _tuple_n(output_size, n)
+        in_sp = x.shape[2:2 + n] if not data_format.endswith("C") else x.shape[1:1 + n]
+        k = weight.shape[2:2 + n]
+        op = []
+        base_pad = pad_spec if not isinstance(pad_spec, str) else ((0, 0),) * n
+        for i in range(n):
+            base = (in_sp[i] - 1) * stride[i] - base_pad[i][0] - base_pad[i][1] \
+                + dilation[i] * (k[i] - 1) + 1
+            op.append(int(output_size[i] - base))
+        output_padding = tuple(op)
+    attrs = {"stride": stride, "padding": pad_spec,
+             "output_padding": output_padding, "dilation": dilation,
+             "groups": int(groups), "data_format": data_format}
+    if bias is None:
+        return dispatch.apply(f"conv{n}d_transpose_nobias", [x, weight], attrs)
+    return dispatch.apply(f"conv{n}d_transpose", [x, weight, as_tensor(bias)], attrs)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    data_format = {"NCL": "NCW", "NLC": "NWC"}.get(data_format, data_format)
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

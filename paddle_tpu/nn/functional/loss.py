@@ -1,0 +1,481 @@
+"""Loss functionals.
+
+Analog of `python/paddle/nn/functional/loss.py`. cross_entropy follows the
+reference's fused softmax_with_cross_entropy semantics
+(`phi/kernels/gpu/cross_entropy_kernel.cu`): log-softmax + gather in one composite
+so XLA fuses it into a single kernel; no materialised one-hot for hard labels.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+           "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "kl_div", "smooth_l1_loss", "margin_ranking_loss", "ctc_loss",
+           "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+           "log_loss", "square_error_cost", "sigmoid_focal_loss",
+           "softmax_with_cross_entropy", "poisson_nll_loss", "multi_label_soft_margin_loss",
+           "soft_margin_loss", "gaussian_nll_loss"]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def _ce_hard_fn(logits, label, axis, ignore_index, label_smoothing, use_softmax):
+    import jax.numpy as jnp
+
+    if use_softmax:
+        lse = jnp.log(jnp.exp(logits - logits.max(axis=axis, keepdims=True)
+                              ).sum(axis=axis, keepdims=True)) \
+            + logits.max(axis=axis, keepdims=True)
+        logp = logits - lse
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    lbl = label
+    squeeze = False
+    if lbl.ndim == logp.ndim:
+        lbl = lbl.squeeze(axis)
+        squeeze = True
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis).astype(jnp.int64),
+                                 axis=axis).squeeze(axis)
+    if label_smoothing > 0.0:
+        # smooth towards uniform: -(1-e)*logp[y] - e/K * sum(logp)
+        k = logits.shape[axis]
+        loss = -(1.0 - label_smoothing) * picked - (label_smoothing / k) * logp.sum(axis=axis)
+    else:
+        loss = -picked
+    loss = jnp.where(valid, loss, jnp.zeros((), loss.dtype))
+    return loss, valid
+
+
+def _ce_soft_fn(logits, label, axis, use_softmax):
+    import jax.numpy as jnp
+
+    if use_softmax:
+        m = logits.max(axis=axis, keepdims=True)
+        lse = jnp.log(jnp.exp(logits - m).sum(axis=axis, keepdims=True)) + m
+        logp = logits - lse
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    return -(label * logp).sum(axis=axis)
+
+
+dispatch.register_op("cross_entropy_hard", _ce_hard_fn, multi_out=True)
+dispatch.register_op("cross_entropy_soft", _ce_soft_fn)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    if soft_label or (label.dtype.is_floating_point and
+                      label.shape == input.shape):
+        loss = dispatch.apply("cross_entropy_soft", [input, label],
+                              {"axis": int(axis), "use_softmax": bool(use_softmax)})
+        if weight is not None:
+            w = as_tensor(weight)
+            from ...ops import linalg  # class weights: weighted mean
+
+            cw = (label * w).sum(axis)
+            loss = loss * cw
+            if reduction == "mean":
+                return loss.sum() / cw.sum()
+        return _reduce(loss, reduction)
+    loss, valid = dispatch.apply(
+        "cross_entropy_hard", [input, label],
+        {"axis": int(axis), "ignore_index": int(ignore_index),
+         "label_smoothing": float(label_smoothing),
+         "use_softmax": bool(use_softmax)})
+    if weight is not None:
+        w = as_tensor(weight)
+        lbl = label
+        if lbl.ndim == input.ndim:
+            lbl = lbl.squeeze(axis)
+        from ...ops import manipulation
+
+        safe_lbl = manipulation.where(valid, lbl,
+                                      manipulation.cast(valid, lbl.dtype) * 0)
+        cw = manipulation.gather(w, manipulation.reshape(safe_lbl, [-1]))
+        cw = manipulation.reshape(cw, lbl.shape) * manipulation.cast(valid, w.dtype)
+        loss = loss * cw
+        if reduction == "mean":
+            return loss.sum() / cw.sum()
+        return _reduce(loss, reduction)
+    if reduction == "mean":
+        from ...ops import manipulation
+
+        denom = manipulation.cast(valid, input.dtype).sum()
+        return loss.sum() / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    from ...ops import activation as act_ops, manipulation
+
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = manipulation.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, act_ops.softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    return _reduce((input - label) * (input - label), reduction)
+
+
+def square_error_cost(input, label):
+    input, label = as_tensor(input), as_tensor(label)
+    return (input - label) * (input - label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    return _reduce((input - label).abs(), reduction)
+
+
+def _nll_fn(logp, label, ignore_index):
+    import jax.numpy as jnp
+
+    # logp: [N, C, ...]; label: [N, ...]
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0).astype(jnp.int64)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+    return jnp.where(valid, -picked, jnp.zeros((), logp.dtype)), valid
+
+
+dispatch.register_op("nll_loss", _nll_fn, multi_out=True)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    loss, valid = dispatch.apply("nll_loss", [input, label],
+                                 {"ignore_index": int(ignore_index)})
+    from ...ops import manipulation
+
+    if weight is not None:
+        w = as_tensor(weight)
+        safe_lbl = manipulation.where(valid, label,
+                                      manipulation.cast(valid, label.dtype) * 0)
+        cw = manipulation.gather(w, manipulation.reshape(safe_lbl, [-1]))
+        cw = manipulation.reshape(cw, label.shape) * manipulation.cast(valid, w.dtype)
+        loss = loss * cw
+        if reduction == "mean":
+            return loss.sum() / cw.sum()
+        return _reduce(loss, reduction)
+    if reduction == "mean":
+        return loss.sum() / manipulation.cast(valid, input.dtype).sum()
+    return _reduce(loss, reduction)
+
+
+def _bce_fn(x, label, epsilon=1e-12):
+    import jax.numpy as jnp
+
+    x = jnp.clip(x, epsilon, 1.0 - epsilon)
+    return -(label * jnp.log(x) + (1 - label) * jnp.log(1 - x))
+
+
+dispatch.register_op("bce", _bce_fn)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    loss = dispatch.apply("bce", [as_tensor(input), as_tensor(label)])
+    if weight is not None:
+        loss = loss * as_tensor(weight)
+    return _reduce(loss, reduction)
+
+
+def _bce_logits_fn(x, label, pos_weight=None):
+    import jax.numpy as jnp
+
+    # numerically-stable: max(x,0) - x*y + log(1+exp(-|x|))
+    neg_abs = -jnp.abs(x)
+    if pos_weight is not None:
+        # (1-y)x + lw*(log(1+exp(-|x|)) + max(-x,0)) with lw = (pw-1)y + 1
+        log_weight = (pos_weight - 1) * label + 1
+        return (1 - label) * x + log_weight * (jnp.log1p(jnp.exp(neg_abs))
+                                               + jnp.maximum(-x, 0))
+    return jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(neg_abs))
+
+
+dispatch.register_op("bce_logits", lambda x, label: _bce_logits_fn(x, label))
+dispatch.register_op("bce_logits_pw", _bce_logits_fn)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit, label = as_tensor(logit), as_tensor(label)
+    if pos_weight is not None:
+        loss = dispatch.apply("bce_logits_pw",
+                              [logit, label, as_tensor(pos_weight)])
+    else:
+        loss = dispatch.apply("bce_logits", [logit, label])
+    if weight is not None:
+        loss = loss * as_tensor(weight)
+    return _reduce(loss, reduction)
+
+
+def _kl_fn(x, target, log_target):
+    import jax.numpy as jnp
+
+    if log_target:
+        return jnp.exp(target) * (target - x)
+    out = target * (jnp.log(jnp.maximum(target, 1e-30)) - x)
+    return jnp.where(target > 0, out, jnp.zeros((), out.dtype))
+
+
+dispatch.register_op("kl_div", _kl_fn)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    loss = dispatch.apply("kl_div", [as_tensor(input), as_tensor(label)],
+                          {"log_target": bool(log_target)})
+    if reduction == "batchmean":
+        return loss.sum() / loss.shape[0]
+    return _reduce(loss, reduction)
+
+
+def _smooth_l1_fn(x, label, delta):
+    import jax.numpy as jnp
+
+    d = jnp.abs(x - label)
+    return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+
+
+dispatch.register_op("smooth_l1", _smooth_l1_fn)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    loss = dispatch.apply("smooth_l1", [as_tensor(input), as_tensor(label)],
+                          {"delta": float(delta)})
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    from ...ops import math as math_ops
+
+    input, other, label = as_tensor(input), as_tensor(other), as_tensor(label)
+    loss = math_ops.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    from ...ops import manipulation, math as math_ops
+
+    input, label = as_tensor(input), as_tensor(label)
+    loss = manipulation.where(label == 1.0, input,
+                              math_ops.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    from . import common
+    from ...ops import manipulation, math as math_ops
+
+    sim = common.cosine_similarity(as_tensor(input1), as_tensor(input2), axis=-1)
+    label = as_tensor(label)
+    loss = manipulation.where(label == 1, 1.0 - sim,
+                              math_ops.maximum(sim - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    from ...ops import math as math_ops, reduction as red_ops
+
+    a, pos, neg = as_tensor(input), as_tensor(positive), as_tensor(negative)
+
+    def pdist(x, y):
+        return math_ops.pow(
+            red_ops.sum(math_ops.pow((x - y).abs() + epsilon, p), axis=-1), 1.0 / p)
+
+    d_pos = pdist(a, pos)
+    d_neg = pdist(a, neg)
+    if swap:
+        d_swap = pdist(pos, neg)
+        d_neg = math_ops.minimum(d_neg, d_swap)
+    loss = math_ops.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    import jax.numpy as jnp
+
+    def fn(x, y, epsilon):
+        return -y * jnp.log(x + epsilon) - (1 - y) * jnp.log(1 - x + epsilon)
+
+    dispatch.register_op("log_loss", fn)
+    return dispatch.apply("log_loss", [as_tensor(input), as_tensor(label)],
+                          {"epsilon": float(epsilon)})
+
+
+def _focal_fn(logit, label, normalizer, alpha, gamma):
+    import jax
+
+    p = jax.nn.sigmoid(logit)
+    ce = _bce_logits_fn(logit, label)
+    p_t = p * label + (1 - p) * (1 - label)
+    alpha_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = alpha_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return loss
+
+
+dispatch.register_op("sigmoid_focal_loss",
+                     lambda logit, label, alpha, gamma:
+                     _focal_fn(logit, label, None, alpha, gamma))
+dispatch.register_op("sigmoid_focal_loss_norm", _focal_fn)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    if normalizer is not None:
+        loss = dispatch.apply("sigmoid_focal_loss_norm",
+                              [as_tensor(logit), as_tensor(label),
+                               as_tensor(normalizer)],
+                              {"alpha": float(alpha), "gamma": float(gamma)})
+    else:
+        loss = dispatch.apply("sigmoid_focal_loss",
+                              [as_tensor(logit), as_tensor(label)],
+                              {"alpha": float(alpha), "gamma": float(gamma)})
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    def fn(x, y, log_input, full, epsilon):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * np.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, jnp.zeros((), loss.dtype))
+        return loss
+
+    dispatch.register_op("poisson_nll", fn)
+    loss = dispatch.apply("poisson_nll", [as_tensor(input), as_tensor(label)],
+                          {"log_input": bool(log_input), "full": bool(full),
+                           "epsilon": float(epsilon)})
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    from ...ops import math as math_ops
+
+    input, label = as_tensor(input), as_tensor(label)
+    loss = math_ops.log1p(math_ops.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    import jax
+
+    def fn(x, y):
+        return -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+
+    dispatch.register_op("ml_soft_margin", fn)
+    loss = dispatch.apply("ml_soft_margin", [as_tensor(input), as_tensor(label)])
+    if weight is not None:
+        loss = loss * as_tensor(weight)
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    import jax.numpy as jnp
+
+    def fn(x, y, var, full, epsilon):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return loss
+
+    dispatch.register_op("gaussian_nll", fn)
+    loss = dispatch.apply("gaussian_nll",
+                          [as_tensor(input), as_tensor(label), as_tensor(variance)],
+                          {"full": bool(full), "epsilon": float(epsilon)})
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(logp, labels, in_len, lbl_len, blank):
+        # logp: [T, B, C] (paddle layout); labels: [B, S]
+        T, B, C = logp.shape
+        S = labels.shape[1]
+        # extended label seq: [blank, l1, blank, l2, ..., blank] length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=labels.dtype)
+        ext = ext.at[:, 1::2].set(labels)
+        ext_len = 2 * lbl_len + 1
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+        alpha = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha = alpha.at[:, 0].set(logp[0, :, blank])
+        first_lbl = jnp.take_along_axis(
+            logp[0], ext[:, 1:2].astype(jnp.int64), axis=1).squeeze(1)
+        alpha = alpha.at[:, 1].set(jnp.where(lbl_len > 0, first_lbl, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logp_t):
+            prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            prev2 = jnp.where(same_as_prev2, neg_inf, prev2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(logp_t, ext.astype(jnp.int64), axis=1)
+            return merged + emit, None
+
+        def masked_scan(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, logp[t])
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(masked_scan, alpha, jnp.arange(1, T))
+        idx_last = (ext_len - 1).astype(jnp.int64)
+        idx_last2 = jnp.maximum(ext_len - 2, 0).astype(jnp.int64)
+        a1 = jnp.take_along_axis(alpha, idx_last[:, None], axis=1).squeeze(1)
+        a2 = jnp.take_along_axis(alpha, idx_last2[:, None], axis=1).squeeze(1)
+        return -jnp.logaddexp(a1, a2)
+
+    dispatch.register_op("ctc_loss", fn)
+    loss = dispatch.apply("ctc_loss",
+                          [as_tensor(log_probs), as_tensor(labels),
+                           as_tensor(input_lengths), as_tensor(label_lengths)],
+                          {"blank": int(blank)})
+    if reduction == "mean":
+        ll = as_tensor(label_lengths)
+        from ...ops import manipulation
+
+        return (loss / manipulation.cast(ll, loss.dtype).clip(1)).mean()
+    return _reduce(loss, reduction)

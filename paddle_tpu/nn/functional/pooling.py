@@ -1,0 +1,346 @@
+"""Pooling over `lax.reduce_window`.
+
+Analog of `python/paddle/nn/functional/pooling.py`; the reference dispatches to
+cuDNN pooling descriptors — here every pool is one `reduce_window` HLO that XLA
+vectorises on the VPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+           "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+           "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool2d"]
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        v = tuple(int(x) for x in v)
+        return v * n if len(v) == 1 else v
+    return (int(v),) * n
+
+
+def _norm_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return ((padding, padding),) * n
+    padding = list(padding)
+    if len(padding) == n:
+        if all(isinstance(p, (list, tuple)) for p in padding):
+            return tuple(tuple(int(x) for x in p) for p in padding)
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1]))
+                     for i in range(n))
+    raise ValueError(f"bad padding {padding}")
+
+
+def _window_dims(n, kernel, stride, channel_last):
+    if channel_last:
+        return (1,) + kernel + (1,), (1,) + stride + (1,)
+    return (1, 1) + kernel, (1, 1) + stride
+
+
+def _full_pad(pad, n, channel_last):
+    if isinstance(pad, str):
+        return pad
+    if channel_last:
+        return ((0, 0),) + pad + ((0, 0),)
+    return ((0, 0), (0, 0)) + pad
+
+
+def _pool_fn(x, kernel, stride, padding, n, kind, ceil_mode, exclusive,
+             data_format):
+    import jax
+    import jax.numpy as jnp
+
+    channel_last = data_format.endswith("C")
+    wdims, wstrides = _window_dims(n, kernel, stride, channel_last)
+    pad = _full_pad(padding, n, channel_last)
+    if isinstance(pad, str):
+        pads = jax.lax.padtype_to_pads(x.shape, wdims, wstrides, pad)
+    else:
+        pads = list(pad)
+    if ceil_mode:
+        pads = _ceil_pads(x.shape, wdims, wstrides, pads)
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+                                     wdims, wstrides, pads)
+    # avg
+    summed = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
+                                   wdims, wstrides, pads)
+    if exclusive:
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype), jax.lax.add,
+                                       wdims, wstrides, pads)
+        return summed / counts
+    return summed / np.prod(kernel)
+
+
+def _ceil_pads(shape, wdims, wstrides, pads):
+    out = []
+    for s, k, st, (lo, hi) in zip(shape, wdims, wstrides, pads):
+        padded = s + lo + hi
+        rem = (padded - k) % st if padded >= k else 0
+        out.append((lo, hi + ((st - rem) % st if rem else 0)))
+    return out
+
+
+for _n in (1, 2, 3):
+    dispatch.register_op(
+        f"pool{_n}d",
+        (lambda n: lambda x, kernel, stride, padding, kind, ceil_mode, exclusive,
+         data_format: _pool_fn(x, kernel, stride, padding, n, kind, ceil_mode,
+                               exclusive, data_format))(_n))
+
+
+def _pool(x, kernel_size, stride, padding, n, kind, ceil_mode=False,
+          exclusive=True, data_format=None):
+    x = as_tensor(x)
+    kernel = _tuple_n(kernel_size, n)
+    stride = _tuple_n(stride if stride is not None else kernel_size, n)
+    pad = _norm_pad(padding, n)
+    return dispatch.apply(f"pool{n}d", [x],
+                          {"kernel": kernel, "stride": stride, "padding": pad,
+                           "kind": kind, "ceil_mode": bool(ceil_mode),
+                           "exclusive": bool(exclusive),
+                           "data_format": data_format or ("NCHW" if n == 2 else
+                                                          "NCW" if n == 1 else "NCDHW")})
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive,
+                 data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive,
+                 data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, ceil_mode)
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   ceil_mode, data_format)
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def _max_pool_mask_fn(x, kernel, stride, padding, n, ceil_mode,
+                      channel_last=False):
+    """Returns (pooled, flat_indices) — indices into the flattened spatial dims."""
+    import jax
+    import jax.numpy as jnp
+
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    shape_for_idx = ((1,) + spatial + (1,)) if channel_last \
+        else ((1, 1) + spatial)
+    idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(shape_for_idx)
+    idx = jnp.broadcast_to(idx, x.shape)
+    wdims, wstrides = _window_dims(n, kernel, stride, channel_last)
+    pad = _full_pad(padding, n, channel_last)
+    if isinstance(pad, str):
+        pads = jax.lax.padtype_to_pads(x.shape, wdims, wstrides, pad)
+    else:
+        pads = list(pad)
+    if ceil_mode:
+        pads = _ceil_pads(x.shape, wdims, wstrides, pads)
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    out, out_idx = jax.lax.reduce_window(
+        (x, idx), (neg, jnp.asarray(0, jnp.int32)), reducer, wdims, wstrides, pads)
+    return out, out_idx
+
+
+for _n in (1, 2, 3):
+    dispatch.register_op(
+        f"max_pool{_n}d_mask",
+        (lambda n: lambda x, kernel, stride, padding, ceil_mode, channel_last:
+         _max_pool_mask_fn(x, kernel, stride, padding, n, ceil_mode,
+                           channel_last))(_n),
+        multi_out=True)
+
+
+def _max_pool_with_mask(x, kernel_size, stride, padding, n, ceil_mode,
+                        data_format=None):
+    x = as_tensor(x)
+    kernel = _tuple_n(kernel_size, n)
+    stride = _tuple_n(stride if stride is not None else kernel_size, n)
+    pad = _norm_pad(padding, n)
+    channel_last = bool(data_format) and data_format.endswith("C") \
+        and not data_format.startswith("NC")
+    return dispatch.apply(f"max_pool{n}d_mask", [x],
+                          {"kernel": kernel, "stride": stride, "padding": pad,
+                           "ceil_mode": bool(ceil_mode),
+                           "channel_last": channel_last})
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling
+# ---------------------------------------------------------------------------
+
+def _adaptive_pool_fn(x, output_size, n, kind):
+    import jax
+    import jax.numpy as jnp
+
+    spatial = x.shape[2:2 + n]
+    # exact adaptive pooling: per output cell i, window [floor(i*in/out), ceil((i+1)*in/out))
+    # Implemented as a matmul with per-dim averaging matrices (XLA-friendly, exact).
+    y = x
+    for d in range(n):
+        in_s, out_s = spatial[d], output_size[d]
+        starts = (np.arange(out_s) * in_s) // out_s
+        ends = -(-((np.arange(out_s) + 1) * in_s) // out_s)
+        if kind == "avg":
+            m = np.zeros((in_s, out_s), dtype=np.float64)
+            for i, (s, e) in enumerate(zip(starts, ends)):
+                m[s:e, i] = 1.0 / (e - s)
+            mat = jnp.asarray(m, x.dtype)
+            y = jnp.moveaxis(jnp.tensordot(y, mat, axes=([2 + d], [0])), -1, 2 + d)
+        else:
+            segs = []
+            axis = 2 + d
+            for s, e in zip(starts, ends):
+                sl = [np.s_[:]] * y.ndim
+                sl[axis] = np.s_[int(s):int(e)]
+                segs.append(y[tuple(sl)].max(axis=axis, keepdims=True))
+            y = jnp.concatenate(segs, axis=axis)
+    return y
+
+
+for _n in (1, 2, 3):
+    for _kind in ("avg", "max"):
+        dispatch.register_op(
+            f"adaptive_{_kind}_pool{_n}d",
+            (lambda n, kind: lambda x, output_size:
+             _adaptive_pool_fn(x, output_size, n, kind))(_n, _kind))
+
+
+def _adaptive(x, output_size, n, kind):
+    x = as_tensor(x)
+    if isinstance(output_size, (list, tuple)):
+        os_ = tuple(int(x.shape[2 + i]) if v is None else int(v)
+                    for i, v in enumerate(output_size))
+    else:
+        os_ = (int(output_size),) * n
+    return dispatch.apply(f"adaptive_{kind}_pool{n}d", [x], {"output_size": os_})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              name=None):
+    from ...ops import math as math_ops
+
+    p = float(norm_type)
+    xp = math_ops.pow(as_tensor(x).abs(), p)
+    pooled = _pool(xp, kernel_size, stride, padding, 1, "avg", ceil_mode,
+                   exclusive=False)
+    k = np.prod(_tuple_n(kernel_size, 1))
+    return math_ops.pow(pooled * float(k), 1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    from ...ops import math as math_ops
+
+    p = float(norm_type)
+    xp = math_ops.pow(as_tensor(x).abs(), p)
+    pooled = _pool(xp, kernel_size, stride, padding, 2, "avg", ceil_mode,
+                   exclusive=False, data_format=data_format)
+    k = np.prod(_tuple_n(kernel_size, 2))
+    return math_ops.pow(pooled * float(k), 1.0 / p)
+
+
+def _max_unpool2d_fn(x, indices, output_size):
+    import jax.numpy as jnp
+
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx].set(vals)
+    return flat.reshape(n, c, oh, ow)
+
+
+dispatch.register_op("max_unpool2d", _max_unpool2d_fn)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    x, indices = as_tensor(x), as_tensor(indices)
+    kernel = _tuple_n(kernel_size, 2)
+    stride = _tuple_n(stride if stride is not None else kernel_size, 2)
+    pad = _tuple_n(padding, 2)
+    if output_size is None:
+        h, w = x.shape[2], x.shape[3]
+        output_size = ((h - 1) * stride[0] - 2 * pad[0] + kernel[0],
+                       (w - 1) * stride[1] - 2 * pad[1] + kernel[1])
+    else:
+        output_size = tuple(int(v) for v in output_size)[-2:]
+    return dispatch.apply("max_unpool2d", [x, indices],
+                          {"output_size": output_size})

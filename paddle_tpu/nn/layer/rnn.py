@@ -1,0 +1,420 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU over `lax.scan`.
+
+Analog of `python/paddle/nn/layer/rnn.py`. The reference uses cuDNN RNN descriptors
+(`phi/kernels/gpu/rnn_kernel.cu.cc`); on TPU the whole multi-layer RNN is ONE
+composite op whose time loop is a `lax.scan` — XLA compiles it to a single fused
+while-loop program, and `jax.vjp` of the scan provides BPTT.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x, state, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    import jax
+    import jax.numpy as jnp
+
+    if mode == "LSTM":
+        h, c = state
+        gates = x @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            gates = gates + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+    if mode == "GRU":
+        h = state
+        gi = x @ w_ih.T
+        gh = h @ w_hh.T
+        if b_ih is not None:
+            gi = gi + b_ih
+            gh = gh + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * h
+        return h, h
+    # SimpleRNN
+    h = state
+    out = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        out = out + b_ih + b_hh
+    h = jnp.tanh(out) if activation == "tanh" else jax.nn.relu(out)
+    return h, h
+
+
+def _rnn_fn(mode, num_layers, bidirectional, has_bias, time_major, activation,
+            x, init_states, weights, dropout=0.0, raw_key=None):
+    """x: [B, T, I] (or [T, B, I] if time_major). Returns (out, final_states)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # -> [T, B, I]
+    num_dir = 2 if bidirectional else 1
+    stride = 4 if has_bias else 2
+    layer_in = x
+    final_h, final_c = [], []
+    for layer in range(num_layers):
+        if layer > 0 and dropout > 0.0 and raw_key is not None:
+            # inter-layer dropout on every layer input except the first
+            key = jax.random.fold_in(jax.random.wrap_key_data(raw_key), layer)
+            keep = jax.random.bernoulli(key, 1.0 - dropout, layer_in.shape)
+            layer_in = jnp.where(keep, layer_in / (1.0 - dropout),
+                                 jnp.zeros((), layer_in.dtype))
+        dir_outs = []
+        for d in range(num_dir):
+            wi = (layer * num_dir + d) * stride
+            w_ih, w_hh = weights[wi], weights[wi + 1]
+            b_ih = weights[wi + 2] if has_bias else None
+            b_hh = weights[wi + 3] if has_bias else None
+            idx = layer * num_dir + d
+            if mode == "LSTM":
+                st = (init_states[0][idx], init_states[1][idx])
+            else:
+                st = init_states[0][idx]
+            seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+
+            def step(carry, xt, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                out, new = _cell_step(mode, xt, carry, w_ih, w_hh, b_ih, b_hh,
+                                      activation)
+                return new, out
+
+            last, outs = jax.lax.scan(step, st, seq)
+            if d == 1:
+                outs = jnp.flip(outs, axis=0)
+            dir_outs.append(outs)
+            if mode == "LSTM":
+                final_h.append(last[0])
+                final_c.append(last[1])
+            else:
+                final_h.append(last)
+        layer_in = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 else dir_outs[0]
+    out = layer_in
+    if not time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    h_n = jnp.stack(final_h)
+    if mode == "LSTM":
+        return out, h_n, jnp.stack(final_c)
+    return out, h_n
+
+
+def _register_rnn_ops():
+    for mode in ("LSTM", "GRU", "RNN_TANH", "RNN_RELU"):
+        base_mode = "LSTM" if mode == "LSTM" else ("GRU" if mode == "GRU" else "RNN")
+        act = "relu" if mode == "RNN_RELU" else "tanh"
+
+        def fn(*arrays, mode=base_mode, act=act, num_layers=1,
+               bidirectional=False, has_bias=True, time_major=False,
+               n_states=1, dropout=0.0, has_key=False):
+            x = arrays[0]
+            states = arrays[1:1 + n_states]
+            rest = arrays[1 + n_states:]
+            raw_key = rest[-1] if has_key else None
+            weights = rest[:-1] if has_key else rest
+            return _rnn_fn(mode, num_layers, bidirectional, has_bias, time_major,
+                           act, x, states, weights, dropout, raw_key)
+
+        dispatch.register_op(f"rnn_{mode.lower()}", fn, multi_out=True)
+
+
+_register_rnn_ops()
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import jax.numpy as jnp
+
+        batch = batch_ref.shape[batch_dim_idx]
+        if isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                batch_ref._data.dtype)) for s in self.state_shape)
+        return Tensor(jnp.full((batch,) + tuple(self.state_shape), init_value,
+                               batch_ref._data.dtype))
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+    std = 1.0 / np.sqrt(hidden_size)
+    init = Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        [gates * hidden_size, input_size], attr=weight_ih_attr,
+        default_initializer=init)
+    layer.weight_hh = layer.create_parameter(
+        [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+        default_initializer=init)
+    if bias_ih_attr is False:
+        layer.bias_ih = None
+    else:
+        layer.bias_ih = layer.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+    if bias_hh_attr is False:
+        layer.bias_hh = None
+    else:
+        layer.bias_hh = layer.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        has_bias = self.bias_ih is not None
+        if has_bias:
+            args += [self.bias_ih, self.bias_hh]
+
+        def fn(x, h, w_ih, w_hh, b_ih=None, b_hh=None, activation="tanh"):
+            out, new = _cell_step("RNN", x, h, w_ih, w_hh, b_ih, b_hh, activation)
+            return out
+
+        dispatch.register_op("simple_rnn_cell", fn)
+        out = dispatch.apply("simple_rnn_cell", args,
+                             {"activation": self.activation})
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        has_bias = self.bias_ih is not None
+        if has_bias:
+            args += [self.bias_ih, self.bias_hh]
+
+        def fn(x, h, c, w_ih, w_hh, b_ih=None, b_hh=None):
+            out, (nh, nc) = _cell_step("LSTM", x, (h, c), w_ih, w_hh, b_ih, b_hh)
+            return nh, nc
+
+        dispatch.register_op("lstm_cell", fn, multi_out=True)
+        nh, nc = dispatch.apply("lstm_cell", args)
+        return nh, (nh, nc)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        has_bias = self.bias_ih is not None
+        if has_bias:
+            args += [self.bias_ih, self.bias_hh]
+
+        def fn(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+            out, new = _cell_step("GRU", x, h, w_ih, w_hh, b_ih, b_hh)
+            return out
+
+        dispatch.register_op("gru_cell", fn)
+        out = dispatch.apply("gru_cell", args)
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation
+
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        xs = manipulation.unbind(inputs, axis=t_axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        state = initial_states
+        outs = []
+        for xt in xs:
+            out, state = self.cell(xt, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...ops import manipulation as m
+
+        out = m.stack(outs, axis=t_axis)
+        return out, state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation
+
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return manipulation.concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.has_bias = bias_ih_attr is not False
+        num_dir = 2 if self.bidirectional else 1
+        gates = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._weight_names = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_size = input_size if layer == 0 else hidden_size * num_dir
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter([gates * hidden_size, in_size],
+                                             attr=weight_ih_attr,
+                                             default_initializer=init)
+                w_hh = self.create_parameter([gates * hidden_size, hidden_size],
+                                             attr=weight_hh_attr,
+                                             default_initializer=init)
+                self.add_parameter(f"weight_ih_{sfx}", w_ih)
+                self.add_parameter(f"weight_hh_{sfx}", w_hh)
+                names = [f"weight_ih_{sfx}", f"weight_hh_{sfx}"]
+                if self.has_bias:
+                    b_ih = self.create_parameter([gates * hidden_size],
+                                                 attr=bias_ih_attr, is_bias=True,
+                                                 default_initializer=init)
+                    b_hh = self.create_parameter([gates * hidden_size],
+                                                 attr=bias_hh_attr, is_bias=True,
+                                                 default_initializer=init)
+                    self.add_parameter(f"bias_ih_{sfx}", b_ih)
+                    self.add_parameter(f"bias_hh_{sfx}", b_hh)
+                    names += [f"bias_ih_{sfx}", f"bias_hh_{sfx}"]
+                self._weight_names.extend(names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax
+        import jax.numpy as jnp
+
+        inputs = as_tensor(inputs)
+        num_dir = 2 if self.bidirectional else 1
+        total = self.num_layers * num_dir
+        batch_axis = 1 if self.time_major else 0
+        batch = inputs.shape[batch_axis]
+        if initial_states is None:
+            zeros = Tensor(jnp.zeros((total, batch, self.hidden_size),
+                                     inputs._data.dtype))
+            if self.mode == "LSTM":
+                initial_states = (zeros, Tensor(zeros._data))
+            else:
+                initial_states = zeros
+        states = list(initial_states) if isinstance(initial_states, (tuple, list)) \
+            else [initial_states]
+        weights = [getattr(self, n) for n in self._weight_names]
+        op = {"LSTM": "rnn_lstm", "GRU": "rnn_gru"}.get(
+            self.mode, "rnn_rnn_relu" if self.activation == "relu" else "rnn_rnn_tanh")
+        use_dropout = self.dropout > 0.0 and self.training and self.num_layers > 1
+        extra = []
+        if use_dropout:
+            from ...framework import random as random_mod
+
+            extra = [Tensor(jax.random.key_data(random_mod.next_key()))]
+        outs = dispatch.apply(op, [inputs] + states + weights + extra,
+                              {"num_layers": self.num_layers,
+                               "bidirectional": self.bidirectional,
+                               "has_bias": self.has_bias,
+                               "time_major": self.time_major,
+                               "n_states": len(states),
+                               "dropout": float(self.dropout) if use_dropout else 0.0,
+                               "has_key": use_dropout})
+        if self.mode == "LSTM":
+            out, h, c = outs
+            return out, (h, c)
+        out, h = outs
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

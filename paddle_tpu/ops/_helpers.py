@@ -38,7 +38,7 @@ def result_dtype(xd: np.dtype, yd: np.dtype) -> np.dtype:
     xd, yd = np.dtype(xd), np.dtype(yd)
     if xd == yd:
         return xd
-    xf, yf = np.issubdtype(xd, np.inexact), np.issubdtype(yd, np.inexact)
+    xf, yf = dtype_mod.is_inexact_np(xd), dtype_mod.is_inexact_np(yd)
     if xf and yf:
         # bf16 x f16 -> f32; otherwise numpy promotion (f16xf32->f32 etc.)
         names = {xd.name, yd.name}
@@ -82,7 +82,7 @@ def _scalar_like(scalar, t: Tensor) -> Tensor:
     td = np.dtype(t._data.dtype)
     if isinstance(scalar, (bool, np.bool_)):
         d = np.bool_ if td == np.bool_ else td
-    elif isinstance(scalar, (float, np.floating)) and not np.issubdtype(td, np.inexact):
+    elif isinstance(scalar, (float, np.floating)) and not dtype_mod.is_inexact_np(td):
         d = dtype_mod.get_default_dtype().np_dtype
     elif isinstance(scalar, complex):
         d = np.complex64
@@ -109,7 +109,7 @@ def make_float_unary(op_name: str, jfn):
 
     def api(x, name=None):
         x = as_tensor(x)
-        if not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        if not dtype_mod.is_inexact_np(x._data.dtype):
             from . import manipulation
 
             x = manipulation.cast(x, dtype_mod.get_default_dtype())
@@ -124,7 +124,7 @@ def make_binary(op_name: str, jfn, float_only=False):
 
     def api(x, y, name=None):
         x, y = prep_binary(x, y)
-        if float_only and not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+        if float_only and not dtype_mod.is_inexact_np(x._data.dtype):
             from . import manipulation
 
             fd = dtype_mod.get_default_dtype()

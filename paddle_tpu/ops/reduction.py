@@ -46,7 +46,7 @@ _reg("count_nonzero", lambda x, *, axis, keepdim: jnp.count_nonzero(
 
 def _reduce(opname, x, axis, keepdim, extra=None, cast_int_to=None):
     x = as_tensor(x)
-    if cast_int_to is not None and not np.issubdtype(np.dtype(x._data.dtype), np.inexact):
+    if cast_int_to is not None and not dtype_mod.is_inexact_np(x._data.dtype):
         from .manipulation import cast
 
         x = cast(x, cast_int_to)

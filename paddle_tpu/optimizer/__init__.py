@@ -1,0 +1,4 @@
+"""paddle.optimizer analog."""
+from . import lr  # noqa: F401
+from .optimizer import (LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa: F401
+                        Lamb, Momentum, NAdam, Optimizer, RAdam, RMSProp)

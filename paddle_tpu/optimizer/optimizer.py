@@ -1,0 +1,603 @@
+"""Optimizers with a fused, jit-compiled update step.
+
+Analog of `python/paddle/optimizer/optimizer.py` + the per-op adam/momentum CUDA
+kernels (`phi/kernels/gpu/adam_kernel.cu` etc.). TPU-first: instead of launching
+one fused kernel per parameter, the WHOLE optimizer step over every parameter is
+a single jitted XLA program (donated buffers, no host round-trips), cached per
+parameter-pytree shape. LR arrives as a device scalar so schedulers never force
+recompiles.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+from ..nn.parameter import Parameter
+from . import lr as lr_mod
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp",
+           "Adam", "AdamW", "Adamax", "Lamb", "NAdam", "RAdam", "LBFGS"]
+
+
+class _L2DecayLike:
+    """Accepts paddle regularizer objects (L2Decay) or plain floats."""
+
+    @staticmethod
+    def coeff_of(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        return float(getattr(weight_decay, "_coeff",
+                             getattr(weight_decay, "coeff", 0.0)))
+
+
+class Optimizer:
+    # subclasses list their per-param accumulator names
+    _acc_names: List[str] = []
+    # 'l1'/'l2' fold decay into the grad; 'decoupled' (AdamW) shrinks the param;
+    # 'internal' passes wd through to _update_one (Lamb's trust-ratio fold-in)
+    _wd_mode = "l2"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in eager mode (pass model.parameters())")
+        parameters = list(parameters)
+        if parameters and isinstance(parameters[0], dict):
+            self._param_groups = parameters
+            self._params = [p for g in parameters for p in g["params"]]
+        else:
+            self._params = parameters
+            self._param_groups = [{"params": parameters}]
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[int, object]] = {
+            n: {} for n in self._acc_names}
+        self._global_step = 0
+        self._jitted_updates: Dict[tuple, object] = {}
+        self._master_weights: Dict[int, object] = {}
+        self._use_master_weights = bool(kwargs.get("multi_precision", False))
+        self._group_of: Dict[int, dict] = {}
+        for g in self._param_groups:
+            for p in g["params"]:
+                self._group_of[id(p)] = g
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state -------------------------------------------------------------
+    def _ensure_state(self, p: Parameter):
+        import jax.numpy as jnp
+
+        for name in self._acc_names:
+            if id(p) not in self._accumulators[name]:
+                self._accumulators[name][id(p)] = self._init_acc(name, p)
+        if self._use_master_weights and id(p) not in self._master_weights and \
+                p._data.dtype in (jnp.bfloat16, jnp.float16):
+            self._master_weights[id(p)] = p._data.astype(jnp.float32)
+
+    def _init_acc(self, name: str, p: Parameter):
+        import jax.numpy as jnp
+
+        if name.endswith("_pow"):  # scalar accumulators (beta powers)
+            return jnp.ones((), jnp.float32)
+        dt = p._data.dtype
+        if dt in (jnp.bfloat16, jnp.float16):
+            dt = jnp.float32
+        return jnp.zeros(p._data.shape, dt)
+
+    # -- the fused step ----------------------------------------------------
+    def _update_one(self, p, g, accs: dict, lr, wd: float):
+        """Pure function: returns (new_param, new_accs_dict). Subclass hook."""
+        raise NotImplementedError
+
+    def _wd_of(self, p: Parameter):
+        """(coeff, kind) for one param. kind in {'l1','l2','decoupled','internal'}."""
+        group = self._group_of.get(id(p), {})
+        wd = group.get("weight_decay", self._weight_decay)
+        if wd is None and getattr(p, "regularizer", None) is not None:
+            wd = p.regularizer
+        coeff = _L2DecayLike.coeff_of(wd)
+        kind = self._wd_mode
+        if kind in ("l1", "l2") and type(wd).__name__ == "L1Decay":
+            kind = "l1"
+        if not self._param_decays(p):
+            coeff = 0.0
+        return (coeff, kind)
+
+    def _param_decays(self, p: Parameter) -> bool:
+        """Subclass hook for per-param decay exclusion (AdamW/Lamb fns)."""
+        return True
+
+    def _lr_mult_of(self, p: Parameter) -> float:
+        group = self._group_of.get(id(p), {})
+        mult = float(group.get("learning_rate", 1.0))
+        if isinstance(p, Parameter):
+            mult *= float(p.optimize_attr.get("learning_rate", 1.0))
+        return mult
+
+    def _build_step_fn(self, wds, lr_mults):
+        import jax
+
+        def step_fn(params, grads, accs, masters, lr):
+            new_params, new_accs, new_masters = [], [], []
+            for i in range(len(params)):
+                p, g, m = params[i], grads[i], masters[i]
+                wd, kind = wds[i]
+                plr = lr if lr_mults[i] == 1.0 else lr * lr_mults[i]
+                work = m if m is not None else p
+                gg = g.astype(work.dtype)
+                if wd and kind == "l2":
+                    gg = gg + wd * work
+                elif wd and kind == "l1":
+                    gg = gg + wd * jax.numpy.sign(work)
+                elif wd and kind == "decoupled":
+                    work = work - plr.astype(work.dtype) * wd * work
+                a = {k: accs[k][i] for k in accs}
+                new_work, new_a = self._update_one(work, gg, a, plr, wd)
+                if m is not None:
+                    new_masters.append(new_work)
+                    new_params.append(new_work.astype(p.dtype))
+                else:
+                    new_masters.append(None)
+                    new_params.append(new_work)
+                new_accs.append(new_a)
+            accs_out = {k: [na[k] for na in new_accs] for k in accs}
+            return new_params, accs_out, new_masters
+
+        return jax.jit(step_fn, donate_argnums=(0, 2, 3))
+
+    @property
+    def _lr_array(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.get_lr(), jnp.float32)
+
+    def _clip_grads(self, params_grads):
+        group_clips = [g.get("grad_clip") for g in self._param_groups]
+        if any(c is not None for c in group_clips):
+            out = []
+            for g in self._param_groups:
+                clip = g.get("grad_clip", self._grad_clip) or self._grad_clip
+                ids = {id(p) for p in g["params"]}
+                sub = [(p, gr) for p, gr in params_grads if id(p) in ids]
+                out.extend(clip(sub) if clip is not None else sub)
+            return out
+        if self._grad_clip is not None:
+            return self._grad_clip(params_grads)
+        return params_grads
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._params
+                        if isinstance(p, Tensor) and not p.stop_gradient
+                        and p.grad is not None]
+        if not params_grads:
+            return
+        params_grads = self._clip_grads(params_grads)
+        self._global_step += 1
+        for p, _ in params_grads:
+            self._ensure_state(p)
+        # static per-param decay/lr config is part of the executable key, so the
+        # jitted program re-specialises only when the trainable set changes
+        wds = tuple(self._wd_of(p) for p, _ in params_grads)
+        lr_mults = tuple(self._lr_mult_of(p) for p, _ in params_grads)
+        key = (wds, lr_mults)
+        fn = self._jitted_updates.get(key)
+        if fn is None:
+            fn = self._jitted_updates[key] = self._build_step_fn(wds, lr_mults)
+        params = [p._data for p, _ in params_grads]
+        grads = [g._data for _, g in params_grads]
+        accs = {k: [self._accumulators[k][id(p)] for p, _ in params_grads]
+                for k in self._acc_names}
+        masters = [self._master_weights.get(id(p)) for p, _ in params_grads]
+        new_params, new_accs, new_masters = fn(
+            params, grads, accs, masters, self._lr_array)
+        for i, (p, _) in enumerate(params_grads):
+            p._data = new_params[i]
+            if new_masters[i] is not None:
+                self._master_weights[id(p)] = new_masters[i]
+            for k in self._acc_names:
+                self._accumulators[k][id(p)] = new_accs[k][i]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params:
+            if isinstance(p, Tensor):
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- persistence -------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {}
+        name_of = {id(p): p.name for p in self._params if isinstance(p, Tensor)}
+        for acc, by_param in self._accumulators.items():
+            for pid, arr in by_param.items():
+                sd[f"{name_of.get(pid, pid)}_{acc}"] = Tensor(arr)
+        for pid, arr in self._master_weights.items():
+            sd[f"{name_of.get(pid, pid)}_master"] = Tensor(arr)
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        import jax.numpy as jnp
+
+        name_of = {p.name: p for p in self._params if isinstance(p, Tensor)}
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, lr_mod.LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            arr = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+            for acc in self._acc_names:
+                sfx = f"_{acc}"
+                if key.endswith(sfx):
+                    pname = key[:-len(sfx)]
+                    if pname in name_of:
+                        self._accumulators[acc][id(name_of[pname])] = arr
+                    break
+            else:
+                if key.endswith("_master"):
+                    pname = key[:-len("_master")]
+                    if pname in name_of:
+                        self._master_weights[id(name_of[pname])] = arr
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    _acc_names: List[str] = []
+
+    def _update_one(self, p, g, accs, lr, wd):
+        return p - lr.astype(p.dtype) * g, accs
+
+
+class Momentum(Optimizer):
+    _acc_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._momentum = float(momentum)
+        self._nesterov = use_nesterov
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        # keep velocity in f32 for bf16/f16 params (reference multi-precision)
+        v = self._momentum * accs["velocity"] + g.astype(accs["velocity"].dtype)
+        if self._nesterov:
+            update = g.astype(v.dtype) + self._momentum * v
+        else:
+            update = v
+        return p - (lr.astype(jnp.float32) * update).astype(p.dtype), \
+            {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    _acc_names = ["moment"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._epsilon = float(epsilon)
+        self._init_value = float(initial_accumulator_value)
+
+    def _init_acc(self, name, p):
+        import jax.numpy as jnp
+
+        return jnp.full(p._data.shape, self._init_value, jnp.float32)
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        m = accs["moment"] + (g * g).astype(accs["moment"].dtype)
+        upd = g / (jnp.sqrt(m).astype(p.dtype) + self._epsilon)
+        return p - lr.astype(p.dtype) * upd, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    _acc_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._epsilon = float(epsilon)
+        self._rho = float(rho)
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        sq = self._rho * accs["avg_squared_grad"] + (1 - self._rho) * gf * gf
+        upd = -jnp.sqrt((accs["avg_squared_update"] + self._epsilon) /
+                        (sq + self._epsilon)) * gf
+        sq_upd = self._rho * accs["avg_squared_update"] + \
+            (1 - self._rho) * upd * upd
+        return p + lr.astype(p.dtype) * upd.astype(p.dtype), \
+            {"avg_squared_grad": sq, "avg_squared_update": sq_upd}
+
+
+class RMSProp(Optimizer):
+    _acc_names = ["mean_square", "mean_grad", "momentum_acc"]
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), centered
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        ms = self._rho * accs["mean_square"] + (1 - self._rho) * gf * gf
+        if self._centered:
+            mg = self._rho * accs["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = accs["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * accs["momentum_acc"] + \
+            lr.astype(jnp.float32) * gf / denom
+        return p - mom.astype(p.dtype), \
+            {"mean_square": ms, "mean_grad": mg, "momentum_acc": mom}
+
+
+class Adam(Optimizer):
+    _acc_names = ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision=multi_precision, **kw)
+        self._beta1 = float(beta1) if not isinstance(beta1, Tensor) else float(beta1.item())
+        self._beta2 = float(beta2) if not isinstance(beta2, Tensor) else float(beta2.item())
+        self._epsilon = float(epsilon)
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["moment1"] + (1 - b1) * gf
+        v = b2 * accs["moment2"] + (1 - b2) * gf * gf
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        upd = lr.astype(jnp.float32) * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p - upd.astype(p.dtype), \
+            {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `python/paddle/optimizer/adamw.py`)."""
+
+    _wd_mode = "decoupled"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision, name=name, **kw)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _param_decays(self, p):
+        if self._apply_decay_param_fun is None:
+            return True
+        return bool(self._apply_decay_param_fun(p.name))
+
+
+class Adamax(Optimizer):
+    _acc_names = ["moment", "inf_norm", "beta1_pow"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * accs["moment"] + (1 - self._beta1) * gf
+        u = jnp.maximum(self._beta2 * accs["inf_norm"], jnp.abs(gf))
+        b1p = accs["beta1_pow"] * self._beta1
+        upd = lr.astype(jnp.float32) / (1 - b1p) * m / (u + self._epsilon)
+        return p - upd.astype(p.dtype), \
+            {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    _acc_names = ["moment1", "moment2", "beta1_pow", "beta2_pow"]
+    _wd_mode = "internal"  # decay folded into the trust-ratio numerator
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, **kw)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_decays(self, p):
+        if self._exclude_fn is None:
+            return True
+        return not bool(self._exclude_fn(p))
+
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["moment1"] + (1 - b1) * gf
+        v = b2 * accs["moment2"] + (1 - b2) * gf * gf
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + \
+            wd * p.astype(jnp.float32)
+        w_norm = jnp.sqrt((p.astype(jnp.float32) ** 2).sum())
+        r_norm = jnp.sqrt((r ** 2).sum())
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        upd = lr.astype(jnp.float32) * trust * r
+        return p - upd.astype(p.dtype), \
+            {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class NAdam(Adam):
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["moment1"] + (1 - b1) * gf
+        v = b2 * accs["moment2"] + (1 - b2) * gf * gf
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        mhat = b1 * m / (1 - b1p * b1) + (1 - b1) * gf / (1 - b1p)
+        vhat = v / (1 - b2p)
+        upd = lr.astype(jnp.float32) * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p - upd.astype(p.dtype), \
+            {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class RAdam(Adam):
+    def _update_one(self, p, g, accs, lr, wd):
+        import jax.numpy as jnp
+
+        gf = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["moment1"] + (1 - b1) * gf
+        v = b2 * accs["moment2"] + (1 - b2) * gf * gf
+        b1p = accs["beta1_pow"] * b1
+        b2p = accs["beta2_pow"] * b2
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        t_like = jnp.log(b2p) / jnp.log(b2)  # recover t from beta2^t
+        rho_t = rho_inf - 2.0 * t_like * b2p / (1 - b2p)
+        mhat = m / (1 - b1p)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                     jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+        vhat = jnp.sqrt(v / (1 - b2p))
+        upd_adapt = lr.astype(jnp.float32) * r * mhat / (vhat + self._epsilon)
+        upd_sgd = lr.astype(jnp.float32) * mhat
+        upd = jnp.where(rho_t > 4.0, upd_adapt, upd_sgd)
+        return p - upd.astype(p.dtype), \
+            {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class LBFGS(Optimizer):
+    """Minimal L-BFGS (closure-based), host-side two-loop recursion."""
+
+    _acc_names: List[str] = []
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    def _flat(self, arrs):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32) for a in arrs])
+
+    def step(self, closure=None):
+        import jax.numpy as jnp
+
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        params = [p for p in self._params
+                  if isinstance(p, Tensor) and not p.stop_gradient
+                  and p.grad is not None]
+        flat_grad = self._flat([p.grad._data for p in params])
+        if self._prev_flat_grad is not None:
+            flat_params = self._flat([p._data for p in params])
+            if not hasattr(self, "_prev_flat_params"):
+                self._prev_flat_params = flat_params
+            s = flat_params - self._prev_flat_params
+            y = flat_grad - self._prev_flat_grad
+            ys = float((y * s).sum())
+            if ys > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        q = flat_grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / float((y * s).sum())
+            alpha = rho * float((s * q).sum())
+            alphas.append((alpha, rho))
+            q = q - alpha * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = float((s * y).sum()) / float((y * y).sum())
+            q = q * gamma
+        for (alpha, rho), s, y in zip(reversed(alphas), self._s, self._y):
+            beta = rho * float((y * q).sum())
+            q = q + (alpha - beta) * s
+        direction = -q
+        lr = self.get_lr()
+        self._prev_flat_grad = flat_grad
+        self._prev_flat_params = self._flat([p._data for p in params])
+        offset = 0
+        for p in params:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            upd = direction[offset:offset + n].reshape(p._data.shape)
+            p._data = p._data + lr * upd.astype(p._data.dtype)
+            offset += n
+        return loss

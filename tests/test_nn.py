@@ -1,0 +1,475 @@
+"""nn package tests: layers vs numpy/torch-style references.
+
+Mirrors the reference OpTest strategy (SURVEY.md §4): numeric checks of fwd and
+bwd against closed-form references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+    np.random.seed(0)
+
+
+def t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+class TestLinear:
+    def test_forward_matches_numpy(self):
+        lin = nn.Linear(6, 4)
+        x = np.random.randn(3, 6).astype("float32")
+        out = lin(t(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_backward(self):
+        lin = nn.Linear(6, 4)
+        x = t(np.random.randn(3, 6).astype("float32"), sg=False)
+        lin(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.tile(lin.weight.numpy().sum(1), (3, 1)),
+                                   rtol=1e-5)
+        assert lin.weight.grad.shape == [6, 4]
+        assert lin.bias.grad.shape == [4]
+
+    def test_no_bias(self):
+        lin = nn.Linear(6, 4, bias_attr=False)
+        assert lin.bias is None
+        assert lin(t(np.ones((2, 6), "float32"))).shape == [2, 4]
+
+
+class TestConv:
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = np.random.randn(1, 2, 8, 8).astype("float32")
+        out = conv(t(x))
+        assert out.shape == [1, 3, 8, 8]
+        # valid center pixel check vs direct correlation
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        patch = x[0, :, 2:5, 2:5]
+        expect = (w[1] * patch).sum() + b[1]
+        np.testing.assert_allclose(out.numpy()[0, 1, 3, 3], expect, rtol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, groups=2)
+        out = conv(t(np.random.randn(2, 4, 9, 9).astype("float32")))
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_conv2d_backward(self):
+        conv = nn.Conv2D(2, 3, 3)
+        x = t(np.random.randn(1, 2, 5, 5).astype("float32"), sg=False)
+        conv(x).sum().backward()
+        assert x.grad.shape == [1, 2, 5, 5]
+        assert conv.weight.grad.shape == [3, 2, 3, 3]
+
+    def test_conv1d_conv3d(self):
+        assert nn.Conv1D(2, 4, 3)(t(np.ones((1, 2, 10), "float32"))).shape == \
+            [1, 4, 8]
+        assert nn.Conv3D(1, 2, 2)(t(np.ones((1, 1, 4, 4, 4), "float32"))).shape \
+            == [1, 2, 3, 3, 3]
+
+    def test_conv2d_transpose(self):
+        convt = nn.Conv2DTranspose(3, 2, 3, stride=2, padding=1)
+        out = convt(t(np.random.randn(1, 3, 4, 4).astype("float32")))
+        assert out.shape == [1, 2, 7, 7]
+
+
+class TestPooling:
+    def test_max_avg_pool(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2, 2)
+        ap = F.avg_pool2d(t(x), 2, 2)
+        np.testing.assert_allclose(mp.numpy()[0, 0],
+                                   [[5, 7], [13, 15]])
+        np.testing.assert_allclose(ap.numpy()[0, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive(self):
+        x = t(np.random.randn(2, 3, 7, 9).astype("float32"))
+        out = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(out.numpy()[..., 0, 0],
+                                   x.numpy().mean((2, 3)), rtol=1e-5)
+        assert F.adaptive_max_pool2d(x, (3, 4)).shape == [2, 3, 3, 4]
+
+    def test_return_mask(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        out, mask = F.max_pool2d(t(x), 2, 2, return_mask=True)
+        np.testing.assert_allclose(mask.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+class TestNorm:
+    def test_batchnorm_train_stats(self):
+        bn = nn.BatchNorm1D(4, data_format="NCL")
+        x = np.random.randn(8, 4, 5).astype("float32") * 3 + 1
+        out = bn(t(x))
+        np.testing.assert_allclose(out.numpy().mean((0, 2)), np.zeros(4),
+                                   atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std((0, 2)), np.ones(4),
+                                   atol=1e-3)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+
+    def test_batchnorm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(3)
+        bn.eval()
+        x = np.random.randn(2, 3, 4, 4).astype("float32")
+        out = bn(t(x))
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-4)
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.randn(4, 8).astype("float32")
+        out = ln(t(x))
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = np.random.randn(4, 8).astype("float32")
+        out = rn(t(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = np.random.randn(2, 4, 3, 3).astype("float32")
+        out = gn(t(x)).numpy()
+        grouped = x.reshape(2, 2, 2, 3, 3)
+        ref = (grouped - grouped.mean((2, 3, 4), keepdims=True)) / np.sqrt(
+            grouped.var((2, 3, 4), keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref.reshape(2, 4, 3, 3), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestLoss:
+    def test_cross_entropy_matches_manual(self):
+        logits = np.random.randn(6, 5).astype("float32")
+        labels = np.array([0, 1, 2, 3, 4, 0])
+        loss = F.cross_entropy(t(logits), t(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype("float32")
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(t(logits), t(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(4, 3).astype("float32")
+        soft = np.random.dirichlet(np.ones(3), 4).astype("float32")
+        loss = F.cross_entropy(t(logits), t(soft), soft_label=True)
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        ref = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x = np.random.randn(10).astype("float32")
+        y = (np.random.rand(10) > 0.5).astype("float32")
+        loss = F.binary_cross_entropy_with_logits(t(x), t(y))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+    def test_mse_l1_smooth(self):
+        a = np.random.randn(5).astype("float32")
+        b = np.random.randn(5).astype("float32")
+        np.testing.assert_allclose(float(F.mse_loss(t(a), t(b))),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(F.l1_loss(t(a), t(b))),
+                                   np.abs(a - b).mean(), rtol=1e-5)
+        d = np.abs(a - b)
+        ref = np.where(d < 1.0, 0.5 * d * d, d - 0.5).mean()
+        np.testing.assert_allclose(float(F.smooth_l1_loss(t(a), t(b))), ref,
+                                   rtol=1e-5)
+
+    def test_kl_nll(self):
+        logp = np.log(np.random.dirichlet(np.ones(4), 3)).astype("float32")
+        target = np.random.dirichlet(np.ones(4), 3).astype("float32")
+        ref = (target * (np.log(target) - logp)).sum(-1).mean() / 4 * 4
+        got = float(F.kl_div(t(logp), t(target), reduction="mean"))
+        np.testing.assert_allclose(got, (target * (np.log(target) - logp)).mean(),
+                                   rtol=1e-4)
+        labels = np.array([1, 0, 3])
+        nll = float(F.nll_loss(t(logp), t(labels)))
+        np.testing.assert_allclose(nll, -logp[np.arange(3), labels].mean(),
+                                   rtol=1e-5)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_eval(self):
+        x = t(np.ones((100, 100), "float32"))
+        out = F.dropout(x, 0.5, training=True)
+        kept = out.numpy()
+        assert 0.3 < (kept == 0).mean() < 0.7
+        np.testing.assert_allclose(kept[kept != 0], 2.0)  # upscale
+        np.testing.assert_allclose(F.dropout(x, 0.5, training=False).numpy(),
+                                   np.ones((100, 100)))
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = t(np.array([[1, 0, 3]]))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+        np.testing.assert_allclose(out.numpy()[0, 2], emb.weight.numpy()[3])
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(10, 4)
+        out = emb(t(np.array([1, 1, 2])))
+        out.sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[1], 2 * np.ones(4))
+        np.testing.assert_allclose(g[2], np.ones(4))
+        np.testing.assert_allclose(g[0], np.zeros(4))
+
+
+class TestAttention:
+    def test_sdpa_matches_manual(self):
+        q = np.random.randn(2, 5, 2, 4).astype("float32")
+        k = np.random.randn(2, 5, 2, 4).astype("float32")
+        v = np.random.randn(2, 5, 2, 4).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        s = qh @ kh.transpose(0, 1, 3, 2) / 2.0
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_causal(self):
+        q = np.random.randn(1, 4, 1, 8).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], q[0, 0, 0], rtol=1e-4)
+
+    def test_flash_attention_api(self):
+        q = t(np.random.randn(2, 8, 2, 16).astype("float32"))
+        out, _ = F.flash_attention(q, q, q, causal=True)
+        assert out.shape == [2, 8, 2, 16]
+
+    def test_mha_cache(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = t(np.random.randn(1, 3, 8).astype("float32"))
+        cache = mha.gen_cache(x)
+        step1, cache = mha(x[:, :1], x[:, :1], x[:, :1], cache=cache)
+        step2, cache = mha(x[:, 1:2], x[:, 1:2], x[:, 1:2], cache=cache)
+        full = mha(x[:, :2], attn_mask=None)
+        # causal incremental decode == full pass row 1? (row 1 sees both)
+        assert cache.k.shape[1] == 2
+
+
+class TestTransformer:
+    def test_encoder_stack(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.randn(2, 5, 16).astype("float32"))
+        assert enc(x).shape == [2, 5, 16]
+
+    def test_full_transformer(self):
+        m = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+        src = t(np.random.randn(2, 4, 16).astype("float32"))
+        tgt = t(np.random.randn(2, 3, 16).astype("float32"))
+        assert m(src, tgt).shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = t(np.random.randn(3, 6, 4).astype("float32"))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 6, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(4, 8, direction="bidirect")
+        out, (h, c) = lstm(t(np.random.randn(2, 5, 4).astype("float32")))
+        assert out.shape == [2, 5, 16] and h.shape == [2, 2, 8]
+
+    def test_gru_simple_rnn(self):
+        assert nn.GRU(4, 8)(t(np.ones((2, 5, 4), "float32")))[0].shape == \
+            [2, 5, 8]
+        assert nn.SimpleRNN(4, 8)(t(np.ones((2, 5, 4), "float32")))[0].shape == \
+            [2, 5, 8]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(4, 8)
+        x = t(np.random.randn(2, 5, 4).astype("float32"), sg=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        h, (h2, c2) = cell(t(np.ones((2, 4), "float32")))
+        assert h.shape == [2, 8] and c2.shape == [2, 8]
+
+
+class TestContainers:
+    def test_sequential(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert m(t(np.ones((2, 4), "float32"))).shape == [2, 2]
+        assert len(m) == 3
+        assert isinstance(m[1], nn.ReLU)
+
+    def test_layerlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(list(ll.parameters())) == 8
+
+    def test_layerdict_parameterlist(self):
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+        pl = nn.ParameterList([nn.Linear(2, 2).weight])
+        assert len(pl) == 1
+
+
+class TestLayerMechanics:
+    def test_named_parameters_nested(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        names = dict(m.named_parameters()).keys()
+        assert "0.weight" in names and "1.0.bias" in names
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Dropout(0.5), nn.Linear(2, 2))
+        m.eval()
+        assert not m[0].training
+        m.train()
+        assert m[0].training
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(3)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd and "weight" in sd
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(t(np.ones((1, 2), "float32")))
+        assert calls == [1]
+        h.remove()
+        lin(t(np.ones((1, 2), "float32")))
+        assert calls == [1]
+
+    def test_apply_and_astype(self):
+        m = nn.Linear(2, 2)
+        m.astype("bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+
+    def test_clip_global_norm(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        g1 = t(np.ones(4, "float32") * 10)
+        p1 = nn.Linear(2, 2).weight
+        clip = ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1)])
+        np.testing.assert_allclose(
+            np.linalg.norm(out[0][1].numpy()), 1.0, rtol=1e-5)
+
+
+class TestActivationsLayers:
+    def test_various(self):
+        x = t(np.random.randn(4, 8).astype("float32"))
+        for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Silu, nn.ELU,
+                    nn.LeakyReLU, nn.Hardswish, nn.Mish, nn.Softplus]:
+            assert cls()(x).shape == [4, 8]
+        assert nn.Softmax()(x).numpy().sum(-1) == pytest.approx(
+            np.ones(4), rel=1e-5)
+
+    def test_prelu_param(self):
+        p = nn.PReLU(8, init=0.1)
+        x = t(-np.ones((2, 8), "float32"))
+        np.testing.assert_allclose(p(x).numpy(), -0.1 * np.ones((2, 8)),
+                                   rtol=1e-5)
+
+
+class TestFunctionalMisc:
+    def test_pad_interpolate(self):
+        x = t(np.ones((1, 1, 4, 4), "float32"))
+        assert F.pad(x, [1, 1, 2, 2]).shape == [1, 1, 8, 6]
+        assert F.interpolate(x, size=(8, 8)).shape == [1, 1, 8, 8]
+        assert F.interpolate(x, scale_factor=2, mode="bilinear").shape == \
+            [1, 1, 8, 8]
+
+    def test_unfold(self):
+        x = t(np.random.randn(1, 2, 4, 4).astype("float32"))
+        out = F.unfold(x, 2, 2)
+        assert out.shape == [1, 8, 4]
+
+    def test_pixel_shuffle(self):
+        x = t(np.random.randn(1, 8, 2, 2).astype("float32"))
+        assert F.pixel_shuffle(x, 2).shape == [1, 2, 4, 4]
+
+    def test_normalize(self):
+        x = t(np.random.randn(3, 4).astype("float32"))
+        out = F.normalize(x, axis=1)
+        np.testing.assert_allclose(np.linalg.norm(out.numpy(), axis=1),
+                                   np.ones(3), rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_softmax_with_cross_entropy(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        labels = np.array([[1], [2], [3], [0]])
+        loss = F.softmax_with_cross_entropy(t(logits), t(labels))
+        assert loss.shape == [4, 1]
+        loss2, sm = F.softmax_with_cross_entropy(t(logits), t(labels),
+                                                 return_softmax=True)
+        np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(4), rtol=1e-5)
+
+    def test_max_pool_mask_nhwc(self):
+        x = np.arange(16, dtype="float32").reshape(1, 4, 4, 1)
+        out, mask = F.max_pool2d(t(x), 2, 2, return_mask=True,
+                                 data_format="NHWC")
+        assert out.shape == [1, 2, 2, 1]
+        np.testing.assert_allclose(out.numpy()[0, :, :, 0], [[5, 7], [13, 15]])
+
+    def test_align_corners_bilinear(self):
+        x = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], dtype="float32")
+        up_t = F.interpolate(t(x), size=(4, 4), mode="bilinear",
+                             align_corners=True).numpy()[0, 0]
+        up_f = F.interpolate(t(x), size=(4, 4), mode="bilinear",
+                             align_corners=False).numpy()[0, 0]
+        # align_corners=True: corners map exactly, rows linspace(0,1,4) etc.
+        np.testing.assert_allclose(up_t[0, 0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(up_t[3, 3], 3.0, atol=1e-6)
+        np.testing.assert_allclose(up_t[0], [0, 1 / 3, 2 / 3, 1.0], atol=1e-6)
+        # half-pixel clamps borders: row 0 = [0, .25, .75, 1]
+        np.testing.assert_allclose(up_f[0], [0, 0.25, 0.75, 1.0], atol=1e-6)
+        assert not np.allclose(up_t, up_f)
+
+    def test_rnn_interlayer_dropout(self):
+        paddle.seed(3)
+        lstm = nn.LSTM(8, 8, num_layers=2, dropout=0.9)
+        x = t(np.random.randn(2, 5, 8).astype("float32"))
+        lstm.train()
+        out_train1, _ = lstm(x)
+        out_train2, _ = lstm(x)
+        assert not np.allclose(out_train1.numpy(), out_train2.numpy())
+        lstm.eval()
+        out_eval1, _ = lstm(x)
+        out_eval2, _ = lstm(x)
+        np.testing.assert_allclose(out_eval1.numpy(), out_eval2.numpy())

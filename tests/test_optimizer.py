@@ -1,0 +1,263 @@
+"""Optimizer + LR scheduler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+    np.random.seed(7)
+
+
+def _fit(optimizer_factory, steps=150, lr_check=0.05):
+    X = np.random.randn(64, 10).astype("float32")
+    W = np.random.randn(10, 1).astype("float32")
+    Y = X @ W
+    model = nn.Linear(10, 1)
+    o = optimizer_factory(model.parameters())
+    xs, ys = paddle.to_tensor(X), paddle.to_tensor(Y)
+    loss = None
+    for _ in range(steps):
+        loss = ((model(xs) - ys) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return float(loss)
+
+
+class TestConvergence:
+    def test_sgd(self):
+        assert _fit(lambda ps: opt.SGD(0.1, parameters=ps)) < 1e-2
+
+    def test_momentum(self):
+        assert _fit(lambda ps: opt.Momentum(0.05, parameters=ps)) < 1e-2
+
+    def test_momentum_nesterov(self):
+        assert _fit(lambda ps: opt.Momentum(0.05, parameters=ps,
+                                            use_nesterov=True)) < 1e-2
+
+    def test_adam(self):
+        assert _fit(lambda ps: opt.Adam(0.05, parameters=ps)) < 1e-2
+
+    def test_adamw(self):
+        assert _fit(lambda ps: opt.AdamW(0.05, parameters=ps)) < 1e-2
+
+    def test_adagrad(self):
+        assert _fit(lambda ps: opt.Adagrad(0.5, parameters=ps), 300) < 1e-2
+
+    def test_rmsprop(self):
+        assert _fit(lambda ps: opt.RMSProp(0.05, parameters=ps), 300) < 5e-2
+
+    def test_adamax(self):
+        assert _fit(lambda ps: opt.Adamax(0.05, parameters=ps), 300) < 1e-2
+
+    def test_lamb(self):
+        assert _fit(lambda ps: opt.Lamb(0.03, parameters=ps), 300) < 1e-2
+
+    def test_nadam_radam(self):
+        assert _fit(lambda ps: opt.NAdam(0.05, parameters=ps), 200) < 1e-2
+        assert _fit(lambda ps: opt.RAdam(0.05, parameters=ps), 300) < 1e-2
+
+    def test_adadelta(self):
+        assert _fit(lambda ps: opt.Adadelta(1.0, rho=0.9, parameters=ps),
+                    400) < 0.3  # adadelta is slow by design
+
+
+class TestOptimizerMechanics:
+    def test_sgd_exact_update(self):
+        p = nn.Linear(2, 2).weight
+        before = p.numpy().copy()
+        o = opt.SGD(0.5, parameters=[p])
+        p.grad = paddle.to_tensor(np.ones((2, 2), "float32"))
+        o.step()
+        np.testing.assert_allclose(p.numpy(), before - 0.5, rtol=1e-6)
+
+    def test_weight_decay_l2(self):
+        p = nn.Linear(2, 2).weight
+        before = p.numpy().copy()
+        o = opt.SGD(0.1, parameters=[p], weight_decay=0.1)
+        p.grad = paddle.to_tensor(np.zeros((2, 2), "float32"))
+        o.step()
+        np.testing.assert_allclose(p.numpy(), before * (1 - 0.01), rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p = nn.Linear(2, 2).weight
+        before = p.numpy().copy()
+        o = opt.AdamW(0.1, parameters=[p], weight_decay=0.5)
+        p.grad = paddle.to_tensor(np.zeros((2, 2), "float32"))
+        o.step()
+        # zero grad -> pure decay: p *= (1 - lr*wd)
+        np.testing.assert_allclose(p.numpy(), before * (1 - 0.05), rtol=1e-4)
+
+    def test_grad_clip_integration(self):
+        p = nn.Linear(2, 2).weight
+        o = opt.SGD(1.0, parameters=[p],
+                    grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        before = p.numpy().copy()
+        p.grad = paddle.to_tensor(np.ones((2, 2), "float32") * 100)
+        o.step()
+        assert np.abs(p.numpy() - before).max() < 0.001
+
+    def test_state_dict_roundtrip(self):
+        model = nn.Linear(4, 2)
+        o = opt.Adam(0.01, parameters=model.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        model(x).sum().backward()
+        o.step()
+        sd = o.state_dict()
+        o2 = opt.Adam(0.01, parameters=model.parameters())
+        o2.set_state_dict(sd)
+        pid = id(model.parameters()[0])
+        np.testing.assert_allclose(
+            np.asarray(o2._accumulators["moment1"][pid]),
+            np.asarray(o._accumulators["moment1"][pid]))
+
+    def test_minimize(self):
+        model = nn.Linear(2, 1)
+        o = opt.SGD(0.1, parameters=model.parameters())
+        loss = model(paddle.to_tensor(np.ones((1, 2), "float32"))).sum()
+        o.minimize(loss)
+        assert model.weight.grad is not None
+
+    def test_set_lr_get_lr(self):
+        o = opt.SGD(0.1, parameters=[nn.Linear(2, 2).weight])
+        assert o.get_lr() == 0.1
+        o.set_lr(0.01)
+        assert o.get_lr() == 0.01
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = [s()]
+        for _ in range(4):
+            s.step()
+            lrs.append(s())
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025],
+                                   rtol=1e-6)
+
+    def test_multistep(self):
+        s = opt.lr.MultiStepDecay(1.0, milestones=[2, 4], gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup(self):
+        s = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        s.step(5)
+        assert s() == pytest.approx(0.05)
+        s.step(15)
+        assert s() == pytest.approx(0.1)
+
+    def test_exponential_noam_poly(self):
+        e = opt.lr.ExponentialDecay(1.0, gamma=0.5)
+        e.step(3)
+        assert e() == pytest.approx(0.125)
+        n = opt.lr.NoamDecay(d_model=64, warmup_steps=100)
+        n.step(100)
+        p = opt.lr.PolynomialDecay(1.0, decay_steps=10, end_lr=0.0)
+        p.step(5)
+        assert p() == pytest.approx(0.5)
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(1.0, patience=1, factor=0.1)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s() == pytest.approx(0.1)
+
+    def test_scheduler_with_optimizer(self):
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        o = opt.SGD(sched, parameters=[nn.Linear(2, 2).weight])
+        assert o.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert o.get_lr() == pytest.approx(0.01)
+
+    def test_one_cycle_cyclic(self):
+        s = opt.lr.OneCycleLR(max_learning_rate=1.0, total_steps=100)
+        start = s()
+        for _ in range(29):
+            s.step()
+        peak = s()
+        assert peak > start
+        c = opt.lr.CyclicLR(0.1, 1.0, step_size_up=4)
+        vals = [c()]
+        for _ in range(4):
+            c.step()
+            vals.append(c())
+        assert max(vals) == pytest.approx(1.0)
+
+
+class TestMultiPrecision:
+    def test_master_weights_bf16(self):
+        model = nn.Linear(4, 2)
+        model.astype("bfloat16")
+        o = opt.Adam(0.01, parameters=model.parameters(), multi_precision=True)
+        x = paddle.to_tensor(np.ones((2, 4)).astype("float32")).astype("bfloat16")
+        model(x).sum().backward()
+        o.step()
+        pid = id(model.parameters()[0])
+        assert pid in o._master_weights
+        assert str(np.asarray(o._master_weights[pid]).dtype) == "float32"
+
+
+class TestReviewRegressions:
+    def test_param_groups_per_group_lr(self):
+        import jax.numpy as jnp
+
+        p1 = nn.Linear(2, 2, bias_attr=False).weight
+        p2 = nn.Linear(2, 2, bias_attr=False).weight
+        b1, b2 = p1.numpy().copy(), p2.numpy().copy()
+        o = opt.SGD(0.1, parameters=[
+            {"params": [p1], "learning_rate": 1.0},
+            {"params": [p2], "learning_rate": 0.1}])
+        ones = paddle.to_tensor(np.ones((2, 2), "float32"))
+        p1.grad, p2.grad = ones, ones
+        o.step()
+        np.testing.assert_allclose(p1.numpy(), b1 - 0.1, rtol=1e-5)
+        np.testing.assert_allclose(p2.numpy(), b2 - 0.01, rtol=1e-5)
+
+    def test_adamw_decay_mask_positional(self):
+        p1 = nn.Linear(2, 2, bias_attr=False).weight
+        p2 = nn.Linear(2, 2, bias_attr=False).weight
+        p1.name, p2.name = "decay_me", "no_decay"
+        b2 = p2.numpy().copy()
+        o = opt.AdamW(0.1, parameters=[p1, p2], weight_decay=0.5,
+                      apply_decay_param_fun=lambda n: n == "decay_me")
+        # p1 has NO grad this step; p2 does — mask must follow identity
+        p2.grad = paddle.to_tensor(np.zeros((2, 2), "float32"))
+        o.step()
+        np.testing.assert_allclose(p2.numpy(), b2, atol=1e-7)  # not decayed
+
+    def test_lamb_exclusion(self):
+        p = nn.Linear(2, 2, bias_attr=False).weight
+        before = p.numpy().copy()
+        o = opt.Lamb(0.1, lamb_weight_decay=1.0, parameters=[p],
+                     exclude_from_weight_decay_fn=lambda param: True)
+        p.grad = paddle.to_tensor(np.zeros((2, 2), "float32"))
+        o.step()
+        np.testing.assert_allclose(p.numpy(), before, atol=1e-6)
+
+    def test_l1_decay_is_l1(self):
+        from paddle_tpu.regularizer import L1Decay
+
+        p = nn.Linear(2, 2, bias_attr=False).weight
+        p.set_value(np.full((2, 2), 2.0, "float32"))
+        o = opt.SGD(0.1, parameters=[p], weight_decay=L1Decay(0.5))
+        p.grad = paddle.to_tensor(np.zeros((2, 2), "float32"))
+        o.step()
+        # L1: p -= lr * wd * sign(p) = 2.0 - 0.05
+        np.testing.assert_allclose(p.numpy(), np.full((2, 2), 1.95), rtol=1e-5)
