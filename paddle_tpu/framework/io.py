@@ -1,0 +1,95 @@
+"""paddle.save / paddle.load.
+
+Analog of `python/paddle/framework/io.py:773,1020` — pickle-compatible state
+dicts. Tensors serialise as (dtype-tagged) numpy arrays; loading rebuilds
+framework Tensors (device_put on first use). ``.pdparams/.pdopt`` conventions
+follow the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+class _TensorPayload:
+    """Pickle surrogate for a framework Tensor."""
+
+    def __init__(self, array: np.ndarray, dtype_name: str, stop_gradient=True,
+                 name=None):
+        self.array = array
+        self.dtype_name = dtype_name
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        # bf16/fp8 have no portable numpy repr -> store raw bytes + dtype tag
+        arr = np.asarray(obj._data)
+        if arr.dtype.kind == "V":  # numpy extension dtype (bfloat16 etc.)
+            payload = _TensorPayload(
+                np.frombuffer(arr.tobytes(), np.uint8).reshape(-1),
+                obj.dtype.name, obj.stop_gradient, obj.name)
+            payload.shape = arr.shape
+            payload.raw = True
+            return payload
+        p = _TensorPayload(arr, obj.dtype.name, obj.stop_gradient, obj.name)
+        p.raw = False
+        return p
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from ..core.tensor import Tensor
+    from . import dtype as dtype_mod
+
+    if isinstance(obj, _TensorPayload):
+        if getattr(obj, "raw", False):
+            npd = dtype_mod.to_np(obj.dtype_name)
+            arr = np.frombuffer(obj.array.tobytes(), npd).reshape(obj.shape)
+        else:
+            arr = obj.array
+        if return_numpy:
+            return arr
+        t = Tensor(arr, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTO, **configs):
+    if isinstance(path, str):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+    else:  # file-like
+        pickle.dump(_pack(obj), path, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    if isinstance(path, str):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    else:
+        obj = pickle.load(path)
+    return _unpack(obj, return_numpy=return_numpy)

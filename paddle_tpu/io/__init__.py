@@ -1,0 +1,13 @@
+"""paddle.io analog: Dataset / Sampler / BatchSampler / DataLoader.
+
+Reference: `python/paddle/io/reader.py:1139` (DataLoader) + `io/dataloader/`.
+TPU-first detail: the loader's collate produces pinned host numpy batches; the
+Tensor constructor device_puts them once — input pipelines should overlap host
+prep with device compute (prefetching thread when num_workers > 0).
+"""
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa: F401
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

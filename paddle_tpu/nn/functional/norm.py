@@ -294,7 +294,7 @@ def _lrn_fn(x, size, alpha, beta, k, data_format):
     wdims[channel_axis] = size
     pads = [(0, 0)] * x.ndim
     pads[channel_axis] = (half, size - half - 1)
-    summed = jax.lax.reduce_window(sq, jnp.asarray(0, x.dtype), jax.lax.add,
+    summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
                                    tuple(wdims), (1,) * x.ndim, pads)
     div = (k + alpha * summed) ** beta
     return x / div

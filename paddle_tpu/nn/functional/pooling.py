@@ -69,16 +69,21 @@ def _pool_fn(x, kernel, stride, padding, n, kind, ceil_mode, exclusive,
         pads = list(pad)
     if ceil_mode:
         pads = _ceil_pads(x.shape, wdims, wstrides, pads)
+    # init values must be PYTHON scalars: jax only specialises reduce_window to
+    # the differentiable monoid primitives (reduce_window_max/_sum) for concrete
+    # identity inits; array inits fall back to the generic op with no grad rule.
+    from ...framework.dtype import is_floating_np
+
     if kind == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+        init = -np.inf if is_floating_np(x.dtype) else int(jnp.iinfo(x.dtype).min)
+        return jax.lax.reduce_window(x, init, jax.lax.max,
                                      wdims, wstrides, pads)
     # avg
-    summed = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
-                                   wdims, wstrides, pads)
+    zero = 0.0 if is_floating_np(x.dtype) else 0
+    summed = jax.lax.reduce_window(x, zero, jax.lax.add, wdims, wstrides, pads)
     if exclusive:
         ones = jnp.ones(x.shape, x.dtype)
-        counts = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype), jax.lax.add,
+        counts = jax.lax.reduce_window(ones, zero, jax.lax.add,
                                        wdims, wstrides, pads)
         return summed / counts
     return summed / np.prod(kernel)
@@ -178,8 +183,11 @@ def _max_pool_mask_fn(x, kernel, stride, padding, n, ceil_mode,
         pads = list(pad)
     if ceil_mode:
         pads = _ceil_pads(x.shape, wdims, wstrides, pads)
-    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                      else jnp.iinfo(x.dtype).min, x.dtype)
+    from ...framework.dtype import is_floating_np
+
+    neg_py = -np.inf if is_floating_np(x.dtype) else int(jnp.iinfo(x.dtype).min)
+    # differentiable pooled output via the monoid primitive...
+    out = jax.lax.reduce_window(x, neg_py, jax.lax.max, wdims, wstrides, pads)
 
     def reducer(a, b):
         av, ai = a
@@ -187,8 +195,11 @@ def _max_pool_mask_fn(x, kernel, stride, padding, n, ceil_mode,
         take_b = bv > av
         return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
 
-    out, out_idx = jax.lax.reduce_window(
-        (x, idx), (neg, jnp.asarray(0, jnp.int32)), reducer, wdims, wstrides, pads)
+    # ...indices via the generic pair-reducer on a detached copy (no grad rule)
+    neg = jnp.asarray(neg_py, x.dtype)
+    _, out_idx = jax.lax.reduce_window(
+        (jax.lax.stop_gradient(x), idx), (neg, jnp.asarray(0, jnp.int32)),
+        reducer, wdims, wstrides, pads)
     return out, out_idx
 
 

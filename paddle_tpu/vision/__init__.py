@@ -1,0 +1,2 @@
+"""paddle.vision analog."""
+from . import models  # noqa: F401

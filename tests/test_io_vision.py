@@ -1,0 +1,251 @@
+"""io / save-load / metric / vision tests + the M3 end-to-end training slice."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import io as pio
+from paddle_tpu import metric as pmetric
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+    np.random.seed(0)
+
+
+class _SquareDataset(pio.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataset:
+    def test_tensor_dataset(self):
+        a = paddle.to_tensor(np.arange(10).reshape(10, 1))
+        b = paddle.to_tensor(np.arange(10) * 2)
+        ds = pio.TensorDataset([a, b])
+        assert len(ds) == 10
+        x, y = ds[3]
+        assert int(x.item()) == 3 and int(y.item()) == 6
+
+    def test_concat_subset_split(self):
+        d1, d2 = _SquareDataset(5), _SquareDataset(7)
+        cat = pio.ConcatDataset([d1, d2])
+        assert len(cat) == 12
+        assert float(cat[6][0][0]) == 1.0  # second dataset idx 1
+        sub = pio.Subset(d1, [2, 4])
+        assert float(sub[1][0][0]) == 4.0
+        parts = pio.random_split(_SquareDataset(10), [7, 3])
+        assert len(parts[0]) == 7 and len(parts[1]) == 3
+
+
+class TestSamplers:
+    def test_batch_sampler(self):
+        bs = pio.BatchSampler(_SquareDataset(10), batch_size=3)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        bs = pio.BatchSampler(_SquareDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3
+
+    def test_random_sampler(self):
+        idx = list(pio.RandomSampler(_SquareDataset(10)))
+        assert sorted(idx) == list(range(10))
+
+    def test_distributed_batch_sampler(self):
+        ds = _SquareDataset(10)
+        s0 = pio.DistributedBatchSampler(ds, 2, num_replicas=2, rank=0)
+        s1 = pio.DistributedBatchSampler(ds, 2, num_replicas=2, rank=1)
+        b0 = [i for b in s0 for i in b]
+        b1 = [i for b in s1 for i in b]
+        assert len(b0) == len(b1) == 5
+        assert set(b0) | set(b1) == set(range(10))
+
+
+class TestDataLoader:
+    def test_basic(self):
+        dl = pio.DataLoader(_SquareDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+        np.testing.assert_allclose(y.numpy().squeeze(), [0, 1, 4, 9])
+
+    def test_shuffle_covers_all(self):
+        dl = pio.DataLoader(_SquareDataset(12), batch_size=3, shuffle=True)
+        seen = np.concatenate([x.numpy().squeeze(1) for x, _ in dl])
+        assert sorted(seen.tolist()) == list(range(12))
+
+    def test_workers_prefetch(self):
+        dl = pio.DataLoader(_SquareDataset(20), batch_size=4, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 5
+        all_x = np.concatenate([x.numpy().squeeze(1) for x, _ in batches])
+        assert sorted(all_x.tolist()) == list(range(20))
+
+    def test_iterable_dataset(self):
+        class Stream(pio.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32([i])
+
+        dl = pio.DataLoader(Stream(), batch_size=3)
+        shapes = [b.shape for b in dl]
+        assert shapes == [[3, 1], [3, 1], [1, 1]]
+
+    def test_dict_collate(self):
+        class D(pio.Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32([i]), "b": i}
+
+            def __len__(self):
+                return 4
+
+        batch = next(iter(pio.DataLoader(D(), batch_size=2)))
+        assert batch["a"].shape == [2, 1]
+        assert batch["b"].shape == [2]
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        p = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), p)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(paddle.load(p))
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_bf16_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 4)
+        m.astype("bfloat16")
+        p = str(tmp_path / "bf16.pdparams")
+        paddle.save(m.state_dict(), p)
+        sd = paddle.load(p)
+        assert sd["weight"].dtype == paddle.bfloat16
+        np.testing.assert_allclose(
+            sd["weight"].astype("float32").numpy(),
+            m.weight.astype("float32").numpy())
+
+    def test_optimizer_state(self, tmp_path):
+        m = nn.Linear(4, 2)
+        o = opt.Adam(0.01, parameters=m.parameters())
+        m(paddle.to_tensor(np.ones((1, 4), "float32"))).sum().backward()
+        o.step()
+        p = str(tmp_path / "opt.pdopt")
+        paddle.save(o.state_dict(), p)
+        loaded = paddle.load(p)
+        assert "global_step" in loaded
+
+    def test_nested_structures(self, tmp_path):
+        obj = {"a": [paddle.to_tensor(np.eye(3)), 5], "b": "text"}
+        p = str(tmp_path / "obj.pkl")
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        np.testing.assert_allclose(back["a"][0].numpy(), np.eye(3))
+        assert back["a"][1] == 5 and back["b"] == "text"
+
+
+class TestMetric:
+    def test_accuracy_metric(self):
+        m = pmetric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        label = paddle.to_tensor(np.array([[1], [1]]))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        assert m.accumulate() == pytest.approx(0.5)
+
+    def test_accuracy_fn(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        label = paddle.to_tensor(np.array([1, 0]))
+        assert float(pmetric.accuracy(pred, label)) == pytest.approx(1.0)
+
+    def test_precision_recall(self):
+        p = pmetric.Precision()
+        r = pmetric.Recall()
+        preds = np.array([0.9, 0.9, 0.1, 0.1], "float32")
+        labels = np.array([1, 0, 1, 0])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(0.5)
+        assert r.accumulate() == pytest.approx(0.5)
+
+    def test_auc(self):
+        auc = pmetric.Auc()
+        preds = np.array([0.1, 0.2, 0.8, 0.9], "float32")
+        labels = np.array([0, 0, 1, 1])
+        auc.update(preds, labels)
+        assert auc.accumulate() == pytest.approx(1.0)
+
+
+class TestVision:
+    def test_resnet18_forward_backward(self):
+        m = paddle.vision.models.resnet18(num_classes=10)
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"),
+                             stop_gradient=False)
+        out = m(x)
+        assert out.shape == [2, 10]
+        out.sum().backward()
+        assert m.conv1.weight.grad is not None
+
+    def test_resnet50_shapes(self):
+        m = paddle.vision.models.resnet50(num_classes=10)
+        m.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+        assert m(x).shape == [1, 10]
+        n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+        # resnet50 with 10 classes ~= 23.5M params
+        assert 23_000_000 < n_params < 24_500_000
+
+    def test_lenet(self):
+        m = paddle.vision.models.LeNet()
+        x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32"))
+        assert m(x).shape == [2, 10]
+
+
+class TestEndToEndSlice:
+    """SURVEY.md §7.2 M3 exit criterion: full train loop with DataLoader +
+    model + loss + optimizer + metric converges."""
+
+    def test_lenet_mnist_style(self):
+        rng = np.random.default_rng(0)
+        # synthetic 2-class 'digits': class 0 = bright top, class 1 = bright bottom
+        n = 64
+        imgs = rng.normal(0, 0.1, (n, 1, 28, 28)).astype("float32")
+        labels = rng.integers(0, 2, n)
+        imgs[labels == 0, :, :14] += 1.0
+        imgs[labels == 1, :, 14:] += 1.0
+
+        class DS(pio.Dataset):
+            def __getitem__(self, i):
+                return imgs[i], np.int64(labels[i])
+
+            def __len__(self):
+                return n
+
+        model = paddle.vision.models.LeNet(num_classes=2)
+        o = opt.Adam(3e-3, parameters=model.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        acc = pmetric.Accuracy()
+        dl = pio.DataLoader(DS(), batch_size=16, shuffle=True)
+        final = None
+        for epoch in range(4):
+            for x, y in dl:
+                loss = loss_fn(model(x), y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                final = float(loss)
+        model.eval()
+        acc.reset()
+        for x, y in pio.DataLoader(DS(), batch_size=16):
+            acc.update(acc.compute(model(x), y.unsqueeze(-1)))
+        assert acc.accumulate() > 0.95, (final, acc.accumulate())
