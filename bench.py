@@ -1,0 +1,122 @@
+"""Benchmark: Llama train-step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: model FLOPs utilisation (MFU) of a bf16 Llama train step (fwd+bwd+AdamW),
+the BASELINE.md config-3 metric measured on the smallest representative slice
+(one chip). vs_baseline = MFU / 0.45 (the north-star >=45% MFU target).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# peak dense bf16 FLOPs per chip by PJRT device_kind (public spec sheets)
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    if device.platform == "cpu":
+        return 1e12  # nominal, so the script still runs off-TPU
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import functional_call, state_arrays
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    # single-chip slice of the 7B-shaped workload (fits HBM without remat)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=4,
+                          num_attention_heads=16,
+                          max_position_embeddings=1024)
+        batch, seq, steps = 4, 1024, 10
+    else:  # smoke-test shape for CPU runs
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=172, num_hidden_layers=2,
+                          num_attention_heads=4, max_position_embeddings=128)
+        batch, seq, steps = 2, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    model.train()
+    # bf16 weights, f32 Adam moments (master weights live in the moments update)
+    params = {k: v.astype(jnp.bfloat16)
+              for k, v in state_arrays(model).items()}
+    m_state = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    v_state = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+
+    def train_step(params, m_state, v_state, step, ids, labels):
+        def loss_fn(p):
+            loss, _ = functional_call(model, p, Tensor(ids),
+                                      labels=Tensor(labels))
+            return loss._data.astype(jnp.float32)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        b1, b2, lr, eps, wd = 0.9, 0.95, 3e-4, 1e-8, 0.1
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            g = grads[k].astype(jnp.float32)
+            new_m[k] = b1 * m_state[k] + (1 - b1) * g
+            new_v[k] = b2 * v_state[k] + (1 - b2) * g * g
+            mhat = new_m[k] / (1 - b1 ** step)
+            vhat = new_v[k] / (1 - b2 ** step)
+            pf = params[k].astype(jnp.float32)
+            pf = pf - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * pf)
+            new_p[k] = pf.astype(params[k].dtype)
+        return loss, new_p, new_m, new_v
+
+    step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup (compile)
+    loss, params, m_state, v_state = step_fn(params, m_state, v_state, 1.0,
+                                             ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params, m_state, v_state = step_fn(params, m_state, v_state,
+                                                 float(i + 2), ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step / dt
+    flops_per_token = model.flops_per_token(seq)
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_train_mfu_1chip",
+        "value": round(float(mfu), 4),
+        "unit": f"MFU (tok/s={tokens_per_sec:.0f}, loss={float(loss):.3f}, "
+                f"{dev.device_kind or dev.platform})",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""paddle.jit analog (M4 grows here): functional_call bridge + to_static."""
+from .functional import buffer_arrays, functional_call, state_arrays  # noqa: F401

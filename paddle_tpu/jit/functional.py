@@ -1,0 +1,60 @@
+"""functional_call: run an nn.Layer with externally-supplied parameter arrays.
+
+The bridge between the eager Layer API and whole-program XLA: swap every
+parameter/buffer's device buffer for a traced array, run forward, restore. This
+is how `to_static`, the bench harness and the distributed train steps compile a
+Layer end-to-end without per-op dispatch (reference analog: program capture in
+`python/paddle/jit/dy2static/program_translator.py`, done here the JAX way).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from ..core.tensor import Tensor
+
+__all__ = ["functional_call", "state_arrays", "buffer_arrays"]
+
+
+def state_arrays(layer) -> Dict[str, object]:
+    """name -> jax.Array for every trainable parameter."""
+    return {name: p._data for name, p in layer.named_parameters()}
+
+
+def buffer_arrays(layer) -> Dict[str, object]:
+    return {name: b._data for name, b in layer.named_buffers()}
+
+
+@contextlib.contextmanager
+def _swapped(layer, params: Dict[str, object], buffers: Dict[str, object] = None):
+    saved = {}
+    named = dict(layer.named_parameters())
+    named_buf = dict(layer.named_buffers())
+    try:
+        for name, arr in params.items():
+            t = named.get(name)
+            if t is None:
+                raise KeyError(f"unknown parameter {name}")
+            saved[id(t)] = (t, t._data)
+            t._data = arr
+        if buffers:
+            for name, arr in buffers.items():
+                t = named_buf.get(name)
+                if t is None:
+                    continue
+                saved[id(t)] = (t, t._data)
+                t._data = arr
+        yield
+    finally:
+        for t, data in saved.values():
+            t._data = data
+
+
+def functional_call(layer, params: Dict[str, object], *args,
+                    buffers: Dict[str, object] = None, **kwargs):
+    """Run ``layer(*args, **kwargs)`` using ``params`` (arrays or tracers) as
+    its weights. Returns whatever forward returns (Tensors wrap the traced
+    arrays when called under jax tracing)."""
+    wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
+    with _swapped(layer, params, buffers):
+        return layer(*wrapped, **kwargs)

@@ -1,0 +1,3 @@
+"""Model zoo: flagship LLM families built from paddle_tpu.nn."""
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,  # noqa: F401
+                    llama_7b_shaped, llama_tiny)

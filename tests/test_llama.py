@@ -1,0 +1,139 @@
+"""Flagship Llama model + functional_call + graft entry tests."""
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import functional_call, state_arrays
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+    np.random.seed(0)
+
+
+def test_forward_shapes():
+    m = llama_tiny(vocab=100, layers=2, hidden=32, heads=4, seq=16)
+    ids = paddle.to_tensor(np.random.randint(0, 100, (2, 16)))
+    logits = m(ids)
+    assert logits.shape == [2, 16, 100]
+
+
+def test_gqa():
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=50, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=16))
+    ids = paddle.to_tensor(np.random.randint(0, 50, (1, 8)))
+    assert m(ids).shape == [1, 8, 50]
+    # kv projections really are smaller
+    att = m.llama.layers[0].self_attn
+    assert att.k_proj.weight.shape == [32, 16]
+
+
+def test_loss_and_grads():
+    m = llama_tiny(vocab=60, layers=2, hidden=32, heads=4, seq=16)
+    ids = paddle.to_tensor(np.random.randint(0, 60, (2, 16)))
+    labels = paddle.to_tensor(np.random.randint(0, 60, (2, 16)))
+    loss, logits = m(ids, labels=labels)
+    assert loss.shape == []
+    loss.backward()
+    g = m.llama.embed_tokens.weight.grad
+    assert g is not None and np.isfinite(g.numpy()).all()
+    assert np.isfinite(float(loss)) and float(loss) < 10
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    m = llama_tiny(vocab=50, layers=1, hidden=32, heads=4, seq=8)
+    m.eval()
+    a = np.random.randint(0, 50, (1, 8))
+    b = a.copy()
+    b[0, -1] = (b[0, -1] + 1) % 50
+    la = m(paddle.to_tensor(a)).numpy()
+    lb = m(paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(la[0, :-1], lb[0, :-1], atol=1e-5)
+    assert not np.allclose(la[0, -1], lb[0, -1])
+
+
+def test_kv_cache_decode_matches_full():
+    m = llama_tiny(vocab=40, layers=2, hidden=32, heads=4, seq=16)
+    m.eval()
+    ids = np.random.randint(0, 40, (1, 6))
+    full = m(paddle.to_tensor(ids)).numpy()
+    # prefill 5 tokens then decode the 6th incrementally
+    caches = [(None, None)] * 2
+    logits, caches = m(paddle.to_tensor(ids[:, :5]), kv_caches=caches)
+    step, caches = m(paddle.to_tensor(ids[:, 5:6]), position_offset=5,
+                     kv_caches=caches)
+    np.testing.assert_allclose(step.numpy()[0, 0], full[0, 5], rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_rope_rotation_invariants():
+    from paddle_tpu.models.llama import fused_rotary_position_embedding
+
+    q = paddle.to_tensor(np.random.randn(1, 4, 2, 8).astype("float32"))
+    cos = paddle.to_tensor(np.cos(np.random.randn(16, 4)).astype("float32"))
+    sin = paddle.to_tensor(np.sin(np.random.randn(16, 4)).astype("float32"))
+    q2, k2 = fused_rotary_position_embedding(q, q, cos, sin)
+    # norm preserved per pair when cos^2+sin^2=1; here just shape + dtype checks
+    assert q2.shape == [1, 4, 2, 8]
+
+
+def test_functional_call_pure_and_jittable():
+    import jax
+
+    m = llama_tiny(vocab=30, layers=1, hidden=32, heads=4, seq=8)
+    m.eval()
+    params = state_arrays(m)
+    ids = np.random.randint(0, 30, (1, 8))
+
+    def fwd(p, ids):
+        return functional_call(m, p, ids)._data
+
+    eager = m(paddle.to_tensor(ids)).numpy()
+    jitted = np.asarray(jax.jit(fwd)(params, ids))
+    np.testing.assert_allclose(eager, jitted, rtol=2e-5, atol=2e-6)
+    # params swap is restorative
+    assert all(np.shares_memory(np.asarray(params[k]), np.asarray(params[k]))
+               for k in params)
+
+
+def test_functional_call_grad():
+    import jax
+
+    m = llama_tiny(vocab=30, layers=1, hidden=32, heads=4, seq=8)
+    params = state_arrays(m)
+    ids = np.random.randint(0, 30, (2, 8))
+    labels = np.random.randint(0, 30, (2, 8))
+
+    def loss_fn(p):
+        loss, _ = functional_call(m, p, Tensor(ids), labels=Tensor(labels))
+        return loss._data
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    assert set(grads.keys()) == set(params.keys())
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads.values())
+
+
+def test_graft_entry():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+
+    import jax
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (2, 64, 256)
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
