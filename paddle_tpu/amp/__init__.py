@@ -1,0 +1,18 @@
+"""paddle_tpu.amp — auto mixed precision (reference `python/paddle/amp/`).
+
+bf16-first for TPU: `auto_cast` defaults to bfloat16, where the MXU runs at
+full rate and dynamic loss scaling is typically unnecessary (but GradScaler is
+provided for fp16 parity).
+"""
+from . import debugging  # noqa: F401
+from .amp_lists import (AutoMixedPrecisionLists, BLACK_LIST,  # noqa: F401
+                        WHITE_LIST, black_list, white_list)
+from .auto_cast import (amp_decorate, amp_guard, amp_state,  # noqa: F401
+                        auto_cast, decorate, is_bfloat16_supported,
+                        is_float16_supported, need_keep_fp32)
+from .grad_scaler import AmpScaler, GradScaler, OptimizerState  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+           "AmpScaler", "OptimizerState", "AutoMixedPrecisionLists",
+           "is_bfloat16_supported", "is_float16_supported", "debugging",
+           "white_list", "black_list"]

@@ -1,0 +1,200 @@
+"""Auto mixed precision: the `auto_cast` guard and `decorate`.
+
+TPU-native analog of `python/paddle/amp/auto_cast.py`. The reference injects
+per-op cast logic into every generated ad_func (`eager_gen.py:1887-1931`); here
+a single hook installed into `paddle_tpu.core.dispatch.apply` rewrites the
+Tensor inputs of each op through the registered ``cast`` op, so the autograd
+graph contains real cast nodes and gradients cast themselves back to the
+parameter dtype on the way down (bf16-first: TPU MXU native dtype).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from .amp_lists import _EXCLUDED, AutoMixedPrecisionLists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "amp_state",
+           "is_bfloat16_supported", "is_float16_supported",
+           "need_keep_fp32"]
+
+_LOW = (np.dtype("float16"), dtype_mod.bfloat16.np_dtype)
+_CASTABLE = _LOW + (np.dtype("float32"),)
+
+
+class _AmpState:
+    __slots__ = ("enabled", "level", "dtype", "lists", "use_promote", "od")
+
+    def __init__(self):
+        self.enabled = False
+        self.level = "O0"
+        self.dtype = dtype_mod.bfloat16.np_dtype
+        self.lists = None
+        self.use_promote = True
+        self.od = False
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def in_amp_guard() -> bool:
+    return _state.enabled
+
+
+def amp_level() -> str:
+    return _state.level if _state.enabled else "O0"
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True  # every XLA backend we target (TPU/CPU) runs bf16
+
+
+def is_float16_supported(device=None) -> bool:
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def _cast(t: Tensor, np_dtype) -> Tensor:
+    from ..ops import manipulation
+
+    return manipulation.cast(t, np_dtype)
+
+
+def _target_dtype(op_name: str, state: _AmpState) -> Optional[np.dtype]:
+    """None = leave inputs alone; otherwise the dtype to compute in."""
+    if op_name in _EXCLUDED:
+        return None
+    lists = state.lists
+    if op_name in lists.black_list:
+        return np.dtype(np.float32)
+    if state.level == "O2":
+        return state.dtype
+    if op_name in lists.white_list:
+        return state.dtype
+    if state.od:  # OD: white-list-only — every gray op runs fp32
+        return np.dtype(np.float32)
+    return None  # gray op: promotion handled separately
+
+
+def _amp_rewrite(op_name: str, tensor_inputs):
+    state = _state
+    if not state.enabled:
+        return tensor_inputs
+    target = _target_dtype(op_name, state)
+    if target is None:
+        if not state.use_promote or op_name in _EXCLUDED:
+            return tensor_inputs
+        # gray op with mixed float precision: promote low-precision inputs to
+        # float32 so the op runs in the widest present dtype (reference
+        # "promote" behavior for ops in neither list).
+        dts = [np.dtype(t._data.dtype) for t in tensor_inputs
+               if isinstance(t, Tensor) and np.dtype(t._data.dtype) in _CASTABLE]
+        if not dts or np.dtype(np.float32) not in dts:
+            return tensor_inputs
+        target = np.dtype(np.float32)
+    out = []
+    for t in tensor_inputs:
+        if isinstance(t, Tensor):
+            dt = np.dtype(t._data.dtype)
+            if dt in _CASTABLE and dt != target:
+                t = _cast(t, target)
+        out.append(t)
+    return out
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Mixed-precision context (reference `paddle.amp.auto_cast`,
+    `python/paddle/amp/auto_cast.py`). bfloat16 by default: TPU-first."""
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+    if dtype not in ("float16", "bfloat16"):
+        raise ValueError(f"amp dtype must be float16/bfloat16, got {dtype}")
+    prev = (_state.enabled, _state.level, _state.dtype, _state.lists,
+            _state.use_promote, _state.od)
+    _state.enabled = bool(enable) and level != "O0"
+    _state.dtype = (dtype_mod.bfloat16.np_dtype if dtype == "bfloat16"
+                    else np.dtype(np.float16))
+    _state.lists = AutoMixedPrecisionLists(
+        custom_white_list=custom_white_list,
+        custom_black_list=custom_black_list, dtype=dtype)
+    _state.od = level == "OD"
+    _state.level = "O1" if level == "OD" else level
+    _state.use_promote = True if level == "OD" else bool(use_promote)
+    dispatch.set_amp_hook(_amp_rewrite if _state.enabled else None)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.lists,
+         _state.use_promote, _state.od) = prev
+        dispatch.set_amp_hook(_amp_rewrite if _state.enabled else None)
+
+
+amp_guard = auto_cast
+
+
+def need_keep_fp32(layer) -> bool:
+    """Normalization layers keep fp32 params under O2 (reference
+    `auto_cast.py:need_keep_fp32`)."""
+    name = type(layer).__name__
+    return any(k in name for k in
+               ("BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+                "SyncBatchNorm", "LocalResponseNorm", "RMSNorm"))
+
+
+def _cast_layer_params(layer, np_dtype, excluded=()):
+    for sub in layer.sublayers(include_self=True):
+        if need_keep_fp32(sub):
+            continue
+        if excluded and (isinstance(sub, tuple(t for t in excluded
+                                               if isinstance(t, type)))
+                         or any(sub is e for e in excluded
+                                if not isinstance(e, type))):
+            continue
+        for p in list(sub.parameters(include_sublayers=False)):
+            if np.dtype(p._data.dtype) == np.dtype(np.float32):
+                p._data = p._data.astype(np_dtype)
+        for _, b in sub.named_buffers(include_sublayers=False):
+            if np.dtype(b._data.dtype) == np.dtype(np.float32):
+                b._data = b._data.astype(np_dtype)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 model/optimizer decoration (reference `paddle.amp.decorate`):
+    casts non-norm parameters to the AMP dtype and switches the optimizer to
+    fp32 master weights."""
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    np_dtype = (dtype_mod.bfloat16.np_dtype if dtype == "bfloat16"
+                else np.dtype(np.float16))
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = tuple(excluded_layers or ())
+        for m in model_list:
+            _cast_layer_params(m, np_dtype, excluded)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        if level == "O2" and master_weight is not False:
+            for opt in opt_list:
+                opt._use_master_weights = True
+        ret_opt = opt_list[0] if single_opt else opt_list
+        return (model_list[0] if single_model else model_list), ret_opt
+    return model_list[0] if single_model else model_list
+
+
+amp_decorate = decorate

@@ -26,6 +26,26 @@ from . import autograd
 
 _OP_REGISTRY: Dict[str, "OpDef"] = {}
 
+# AMP input-rewrite hook installed by paddle_tpu.amp (the analog of the
+# auto-cast logic codegen injects into every ad_func, `eager_gen.py:1887`).
+_amp_hook: Optional[Callable] = None
+# observers fed (op_name, out_tensors) — used by amp.debugging op-stats.
+_op_observers: list = []
+
+
+def set_amp_hook(fn: Optional[Callable]):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def add_op_observer(fn: Callable):
+    _op_observers.append(fn)
+
+
+def remove_op_observer(fn: Callable):
+    if fn in _op_observers:
+        _op_observers.remove(fn)
+
 
 class OpDef:
     """One operator: a pure JAX function ``fn(*arrays, **attrs)``.
@@ -206,6 +226,8 @@ def apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
 
     op = _OP_REGISTRY[op_name]
     attrs = attrs or {}
+    if _amp_hook is not None:
+        tensor_inputs = _amp_hook(op_name, tensor_inputs)
     arrays = [t._data if isinstance(t, Tensor) else t for t in tensor_inputs]
 
     # Graph-capture path: inside jax tracing there is no tape; call through.
@@ -283,6 +305,8 @@ def _wrap_traced(op, out, stop_gradient):
 
 def _maybe_check_nan_inf(name, tensors):
     """FLAGS_check_nan_inf analog (`fluid/eager/nan_inf_utils.h:38`)."""
+    for obs in _op_observers:
+        obs(name, tensors)
     if not flags.flag_value("check_nan_inf"):
         return
     import jax.numpy as jnp

@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — staging ground for fused ops and experimental APIs.
+
+Analog of `python/paddle/incubate/`: the fused transformer functional surface
+(backed here by the Pallas kernel library instead of hand-CUDA), autograd
+extras, and experimental distributed models.
+"""
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

@@ -1,0 +1,235 @@
+"""Fused-op functional API (reference `python/paddle/incubate/nn/functional/`).
+
+Every function here dispatches to a Pallas TPU kernel when available
+(`paddle_tpu.ops.pallas`) and otherwise to the equivalent XLA composite —
+same contract as the reference where these bind CUDA fusion kernels
+(`paddle/phi/kernels/fusion/gpu/`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....core import dispatch
+from ....core.tensor import Tensor
+from ....ops._helpers import as_tensor
+from ....ops.pallas import _support as _psupport
+from ....ops.pallas import bias_act as _pba
+from ....ops.pallas import rms_norm as _prms
+from ....ops.pallas import rope as _prope
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu", "fused_bias_act",
+           "fused_linear", "fused_linear_activation",
+           "variable_length_memory_efficient_attention"]
+
+dispatch.register_op("pallas_rms_norm",
+                     lambda x, w, epsilon: _prms.rms_norm(x, w, epsilon))
+dispatch.register_op("pallas_rope",
+                     lambda q, k, cos, sin, offset:
+                     _prope.fused_rope(q, k, cos, sin, offset),
+                     multi_out=True)
+dispatch.register_op("pallas_bias_act",
+                     lambda x, b, act_method: _pba.fused_bias_act(x, b, act_method))
+dispatch.register_op("pallas_bias_act_nob",
+                     lambda x, act_method: _pba.fused_bias_act(x, None, act_method))
+dispatch.register_op("pallas_swiglu",
+                     lambda x, y: _pba.swiglu(x, y))
+dispatch.register_op("pallas_swiglu_packed",
+                     lambda x: _pba.swiglu(x))
+
+
+def _pallas_on(x) -> bool:
+    return _psupport.kernels_enabled() and str(
+        np.dtype(x._data.dtype)) in ("float32", "bfloat16", "float16")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    """Fused RMSNorm (+optional pre-norm residual add), reference
+    `incubate.nn.functional.fused_rms_norm`. Returns (out, residual_out)."""
+    x = as_tensor(x)
+    if bias is not None:
+        x = x + as_tensor(bias)
+    if residual is not None:
+        x = x + as_tensor(residual)
+    residual_out = x if residual is not None else None
+    w = as_tensor(norm_weight)
+    if _pallas_on(x) and _prms.supported(tuple(x.shape), x._data.dtype):
+        out = dispatch.apply("pallas_rms_norm", [x, w],
+                             {"epsilon": float(epsilon)})
+    else:
+        out = dispatch.apply("rms_norm", [x, w], {"epsilon": float(epsilon)})
+    if norm_bias is not None:
+        out = out + as_tensor(norm_bias)
+    return (out, residual_out) if residual is not None else out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kwargs):
+    """Fused LayerNorm (+residual), reference
+    `incubate.nn.functional.fused_layer_norm`."""
+    from ....nn import functional as F
+
+    x = as_tensor(x)
+    if bias is not None:
+        x = x + as_tensor(bias)
+    if residual is not None:
+        x = x + as_tensor(residual)
+    residual_out = x if residual is not None else None
+    out = F.layer_norm(x, x.shape[-1:], weight=norm_weight, bias=norm_bias,
+                       epsilon=epsilon)
+    return (out, residual_out) if residual is not None else out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0, offset=0):
+    """Reference `incubate.nn.functional.fused_rotary_position_embedding`
+    (kernel `phi/kernels/fusion/gpu/fused_rope_kernel.cu`).
+
+    q/k: [B, S, H, D]. cos/sin: [T, D/2] (half tables) or [T, D]/broadcastable
+    full tables (auto-halved). Rotates the (x[..., :D/2], x[..., D/2:]) pairs
+    (neox style).
+    """
+    import jax.numpy as jnp
+
+    q = as_tensor(q)
+    d = q.shape[-1]
+    if cos is None or sin is None:
+        t = max(q.shape[1] + offset, 1)
+        inv = 1.0 / (rotary_emb_base **
+                     (np.arange(0, d, 2, dtype=np.float64) / d))
+        freqs = np.outer(np.arange(t, dtype=np.float64), inv)
+        cos = Tensor(jnp.asarray(np.cos(freqs), q._data.dtype))
+        sin = Tensor(jnp.asarray(np.sin(freqs), q._data.dtype))
+    cos, sin = as_tensor(cos), as_tensor(sin)
+    # accept [*, T, D] full tables: squeeze + halve
+    if cos.ndim > 2:
+        cos = Tensor(cos._data.reshape(-1, cos.shape[-1]))
+        sin = Tensor(sin._data.reshape(-1, sin.shape[-1]))
+    if cos.shape[-1] == d:
+        cos = Tensor(cos._data[..., : d // 2])
+        sin = Tensor(sin._data[..., : d // 2])
+    single = k is None
+    if single:
+        k = q
+    k = as_tensor(k)
+    attrs = {"offset": int(offset)}
+    if (_pallas_on(q) and _prope.supported(tuple(q.shape), q._data.dtype)
+            and tuple(q.shape) == tuple(k.shape)):
+        oq, ok = dispatch.apply("pallas_rope", [q, k, cos, sin], attrs)
+    else:
+        from ....models import llama as _llama  # noqa: F401  registers fused_rope
+
+        oq, ok = dispatch.apply("fused_rope", [q, k, cos, sin], attrs)
+    if single:
+        return oq
+    if v is not None:
+        return oq, ok, as_tensor(v)
+    return oq, ok
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y (packed split when y is None); reference
+    `incubate.nn.functional.swiglu`."""
+    x = as_tensor(x)
+    if _pallas_on(x):
+        if y is None:
+            return dispatch.apply("pallas_swiglu_packed", [x])
+        return dispatch.apply("pallas_swiglu", [x, as_tensor(y)])
+    if y is None:
+        return dispatch.apply("swiglu_packed", [x])
+    return dispatch.apply("swiglu", [x, as_tensor(y)])
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """Reference `incubate.nn.functional.fused_bias_act`
+    (kernel `phi/kernels/fusion/gpu/fused_bias_act_kernel.cu`)."""
+    x = as_tensor(x)
+    act = act_method.lower()
+    if _pallas_on(x):
+        if bias is None:
+            return dispatch.apply("pallas_bias_act_nob", [x],
+                                  {"act_method": act})
+        return dispatch.apply("pallas_bias_act", [x, as_tensor(bias)],
+                              {"act_method": act})
+    from ....ops.pallas.bias_act import _ref_bias_act
+    import jax.numpy as jnp
+
+    op = "xla_bias_act"
+    if op not in dispatch.op_registry():
+        dispatch.register_op(op, lambda x, b, act_method:
+                             _ref_bias_act(x, b, act_method))
+    b = as_tensor(bias) if bias is not None else \
+        Tensor(jnp.zeros((x.shape[-1],), x._data.dtype))
+    return dispatch.apply(op, [x, b], {"act_method": act})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """matmul+bias in one XLA op (the MXU fuses the epilogue);
+    reference `incubate.nn.functional.fused_linear`."""
+    from ....nn import functional as F
+    from ....ops import manipulation
+
+    w = as_tensor(weight)
+    if transpose_weight:
+        w = manipulation.transpose(w, [1, 0])
+    return F.linear(x, w, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """gemm + bias + activation epilogue (reference
+    `incubate.nn.functional.fused_linear_activation`)."""
+    from ....ops import linalg
+
+    out = linalg.matmul(as_tensor(x), as_tensor(y), transpose_x=trans_x,
+                        transpose_y=trans_y)
+    return fused_bias_act(out, bias, act_method=activation)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Varlen attention (reference
+    `incubate.nn.functional.variable_length_memory_efficient_attention`);
+    maps to the varlen masked composite / Pallas flash path.
+
+    query/key/value: [B, H, S, D]; seq_lens: [B] valid lengths.
+    """
+    import jax.numpy as jnp
+
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    sl, kl = as_tensor(seq_lens), as_tensor(kv_seq_lens)
+
+    def fn(q, k, v, sl, kl, scale, causal):
+        import jax
+
+        d = q.shape[-1]
+        if scale is None:
+            scale = 1.0 / np.sqrt(d)
+        sq, skv = q.shape[2], k.shape[2]
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        qpos = jnp.arange(sq)
+        kpos = jnp.arange(skv)
+        valid = (qpos[:, None] < sl.reshape(-1, 1, 1, 1)[:, :, 0, 0, None]) & \
+                (kpos[None, :] < kl.reshape(-1, 1, 1, 1)[:, :, 0, 0, None])
+        valid = valid[:, None]
+        if causal:
+            valid = valid & (qpos[:, None] >= kpos[None, :])[None, None]
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+    op = "varlen_mea"
+    if op not in dispatch.op_registry():
+        dispatch.register_op(op, fn)
+    return dispatch.apply(op, [q, k, v, sl, kl],
+                          {"scale": scale, "causal": bool(causal)})

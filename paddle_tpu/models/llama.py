@@ -239,7 +239,12 @@ class LlamaForCausalLM(nn.Layer):
         """Model FLOPs per trained token (fwd+bwd), PaLM-appendix accounting:
         6*N_params + 12*L*H*Q*T attention term."""
         c = self.config
-        n_params = sum(int(np.prod(p.shape)) for p in self.parameters())
+        # 6N counts matmul'd params only: the embedding lookup is a gather,
+        # not a matmul (the tied/untied lm_head projection IS a matmul and is
+        # a distinct parameter here, so only embed_tokens is excluded).
+        n_params = sum(int(np.prod(p.shape))
+                       for name, p in self.named_parameters()
+                       if "embed_tokens" not in name)
         attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
         return 6 * n_params + attn
 

@@ -191,7 +191,20 @@ dispatch.register_op("rms_norm", _rms_norm_fn)
 
 
 def rms_norm(x, weight, epsilon=1e-6, name=None):
-    return dispatch.apply("rms_norm", [as_tensor(x), as_tensor(weight)],
+    x = as_tensor(x)
+    try:
+        from ...ops.pallas import _support as _ps
+        from ...ops.pallas import rms_norm as _prms
+
+        if _ps.kernels_enabled() and _prms.supported(tuple(x.shape),
+                                                     x._data.dtype):
+            from ...incubate.nn import functional as _inc  # registers the op
+
+            return dispatch.apply("pallas_rms_norm", [x, as_tensor(weight)],
+                                  {"epsilon": float(epsilon)})
+    except ImportError:
+        pass
+    return dispatch.apply("rms_norm", [x, as_tensor(weight)],
                           {"epsilon": float(epsilon)})
 
 

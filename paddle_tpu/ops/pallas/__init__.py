@@ -1,0 +1,12 @@
+"""paddle_tpu.ops.pallas — the TPU fused-kernel library.
+
+The TPU-native replacement for the reference's hand-written CUDA fusion
+kernels (`paddle/phi/kernels/fusion/gpu/`, SURVEY.md §2.3): flash attention,
+rms_norm, fused rope, fused bias+act/swiglu. Compiled via Mosaic on TPU;
+interpreter mode (FLAGS_pallas_interpret) lets the same kernels run in tests
+on CPU.
+"""
+from . import _support  # noqa: F401
+from . import bias_act, flash_attention, rms_norm, rope  # noqa: F401
+
+__all__ = ["flash_attention", "rms_norm", "rope", "bias_act", "_support"]

@@ -1,0 +1,47 @@
+"""Shared gating/helpers for the Pallas TPU kernel library.
+
+The kernels compile natively on TPU (Mosaic); off-TPU they run through the
+Pallas interpreter when `FLAGS_pallas_interpret` is set (the test path on the
+8-device CPU mesh), else callers fall back to the XLA composite ops.
+"""
+from __future__ import annotations
+
+import functools
+
+from ...framework import flags
+
+flags.define_flag("use_pallas", True, "use Pallas kernels for fused ops on TPU")
+flags.define_flag("pallas_interpret", False,
+                  "run Pallas kernels in interpreter mode off-TPU (tests)")
+
+
+@functools.lru_cache(maxsize=1)
+def backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    return backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """True when kernels must run via the Pallas interpreter (non-TPU)."""
+    return not on_tpu()
+
+
+def kernels_enabled() -> bool:
+    if on_tpu():
+        return bool(flags.flag_value("use_pallas"))
+    return bool(flags.flag_value("pallas_interpret"))
+
+
+def pick_block(n: int, preferred: int = 128) -> int:
+    """Largest power-of-two block <= preferred that divides n (0 if none >= 8)."""
+    b = preferred
+    while b >= 8:
+        if n % b == 0:
+            return b
+        b //= 2
+    return n if n < 8 else 0

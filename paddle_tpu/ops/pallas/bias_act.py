@@ -1,0 +1,99 @@
+"""Pallas fused bias+activation and SwiGLU.
+
+Reference kernels: `paddle/phi/kernels/fusion/gpu/fused_bias_act_kernel.cu`
+and the swiglu op (`python/paddle/incubate/nn/functional/swiglu`). One HBM
+pass: add bias, apply activation (and the GLU product for swiglu/geglu).
+Backward recomputes through the plain-XLA reference (fuses fine).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import _support
+
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "identity": lambda x: x,
+}
+
+
+def _ref_bias_act(x, bias, act_method):
+    xf = x.astype(jnp.float32) + bias.astype(jnp.float32)
+    if act_method in ("swiglu", "geglu"):
+        a, b = jnp.split(xf, 2, axis=-1)
+        inner = _ACTS["silu" if act_method == "swiglu" else "gelu"](a)
+        return (inner * b).astype(x.dtype)
+    return _ACTS[act_method](xf).astype(x.dtype)
+
+
+def _kernel(x_ref, b_ref, y_ref, *, act_method):
+    x = x_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    if act_method in ("swiglu", "geglu"):
+        d2 = x.shape[-1] // 2
+        a, b = x[..., :d2], x[..., d2:]
+        inner = _ACTS["silu" if act_method == "swiglu" else "gelu"](a)
+        y_ref[:] = (inner * b).astype(y_ref.dtype)
+    else:
+        y_ref[:] = _ACTS[act_method](x).astype(y_ref.dtype)
+
+
+def _pallas_bias_act(x2d, bias, act_method):
+    r, hdim = x2d.shape
+    br = _support.pick_block(r, 256) or r
+    out_h = hdim // 2 if act_method in ("swiglu", "geglu") else hdim
+    return pl.pallas_call(
+        functools.partial(_kernel, act_method=act_method),
+        grid=(pl.cdiv(r, br),),
+        in_specs=[
+            pl.BlockSpec((br, hdim), lambda i: (i, 0)),
+            pl.BlockSpec((hdim,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((br, out_h), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, out_h), x2d.dtype),
+        interpret=_support.interpret_mode(),
+    )(x2d, bias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _bias_act2d(x2d, bias, act_method):
+    return _pallas_bias_act(x2d, bias, act_method)
+
+
+def _ba_fwd(x2d, bias, act_method):
+    return _pallas_bias_act(x2d, bias, act_method), (x2d, bias)
+
+
+def _ba_bwd(act_method, res, g):
+    x2d, bias = res
+    _, vjp = jax.vjp(lambda x, b: _ref_bias_act(x, b, act_method), x2d, bias)
+    return vjp(g)
+
+
+_bias_act2d.defvjp(_ba_fwd, _ba_bwd)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    """Raw-array fused bias+act over the last axis."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    if bias is None:
+        bias = jnp.zeros((shape[-1],), x.dtype)
+    y = _bias_act2d(x2d, bias, act_method)
+    return y.reshape(shape[:-1] + (y.shape[-1],))
+
+
+def swiglu(x, y=None):
+    """silu(x) * y; packed form splits x's last axis when y is None."""
+    if y is None:
+        return fused_bias_act(x, None, "swiglu")
+    packed = jnp.concatenate([x, y], axis=-1)
+    return fused_bias_act(packed, None, "swiglu")

@@ -1,0 +1,293 @@
+"""Pallas TPU flash attention (forward + backward kernels).
+
+TPU-native replacement for the reference's CUDA flashattn binding
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu`, python surface
+`python/paddle/nn/functional/flash_attention.py:195`): online-softmax blockwise
+attention that never materialises the S×S score matrix. Layout inside the
+kernels is [B, H, S, D] (MXU-friendly: S×D tiles); K/V live in VMEM per
+(batch, head) which bounds supported seqlen at ~16k for D=128 bf16 — beyond
+that the ring-attention path (`paddle_tpu.distributed.ring_attention`) shards
+the sequence over the mesh instead.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import _support
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_k):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, d)
+    d = q.shape[-1]
+    nkb = seq_k // block_k
+    if causal:
+        hi = jnp.minimum(((i + 1) * block_q + block_k - 1) // block_k, nkb)
+    else:
+        hi = nkb
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale, causal, block_q, block_k, seq_k):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    d = q.shape[-1]
+    nkb = seq_k // block_k
+    hi = (jnp.minimum(((i + 1) * block_q + block_k - 1) // block_k, nkb)
+          if causal else nkb)
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, sm_scale, causal, block_q, block_k, seq_q):
+    j = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                     # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    d = k.shape[-1]
+    nqb = seq_q // block_q
+    lo = (j * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        s = sm_scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nqb, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(seq_q, seq_k):
+    bq = _support.pick_block(seq_q)
+    bk = _support.pick_block(seq_k)
+    return bq, bk
+
+
+def _fa_forward(q, k, v, causal, sm_scale):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _blocks(sq, sk)
+    interp = _support.interpret_mode()
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             block_q=bq, block_k=bk, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(b, h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq * sk * d,
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=b * h * sq * sk),
+        interpret=interp,
+    )(q, k, v)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhsd(q, k, v, causal, sm_scale):
+    out, _ = _fa_forward(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale):
+    out, lse = _fa_forward(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, sm_scale, res, g):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _blocks(sq, sk)
+    interp = _support.interpret_mode()
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, seq_k=sk),
+        grid=(b, h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interp,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, seq_q=sq),
+        grid=(b, h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interp,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None):
+    """Raw-array flash attention in [B, H, S, D] layout."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    return _flash_bhsd(q, k, v, bool(causal), float(sm_scale))
+
+
+def _flash_bshd(q, k, v, causal):
+    """Dispatch op fn: paddle layout [B, S, H, D]."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhsd(qt, kt, vt, causal=causal)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _register():
+    from ...core import dispatch
+
+    if "pallas_flash" not in dispatch.op_registry():
+        dispatch.register_op("pallas_flash", _flash_bshd)
+
+
+def supported(q_shape, k_shape, dtype) -> bool:
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return False
+    b, sq, h, d = q_shape
+    sk = k_shape[1]
+    if h != k_shape[2]:  # GQA: caller must repeat kv heads first
+        return False
+    if d > 256:
+        return False
+    if str(np.dtype(dtype)) not in ("float32", "bfloat16", "float16"):
+        return False
+    bq, bk = _blocks(sq, sk)
+    return bq >= 8 and bk >= 8
+
+
+def maybe_flash(q, k, v, causal):
+    """Tensor-level entry used by nn.functional: returns a Tensor or None."""
+    if not _support.kernels_enabled():
+        return None
+    if not supported(tuple(q.shape), tuple(k.shape), q._data.dtype):
+        return None
+    if causal and q.shape[1] != k.shape[1]:
+        return None
+    from ...core import dispatch
+
+    _register()
+    return dispatch.apply("pallas_flash", [q, k, v], {"causal": bool(causal)})

@@ -1,0 +1,150 @@
+"""AMP tests: auto_cast O1/O2, promote, decorate, GradScaler, op stats.
+
+Mirrors the reference's `test/amp/` strategy (e.g. test_amp_api, amp O1/O2
+dtype assertions) against this framework's bf16-first implementation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn
+
+
+def test_o1_white_op_runs_bf16():
+    x = paddle.Tensor(np.random.rand(8, 16).astype(np.float32))
+    y = paddle.Tensor(np.random.rand(16, 4).astype(np.float32))
+    with amp.auto_cast(level="O1"):
+        out = paddle.matmul(x, y)
+    assert str(out._data.dtype) == "bfloat16"
+    # outside the guard back to fp32
+    out2 = paddle.matmul(x, y)
+    assert str(out2._data.dtype) == "float32"
+
+
+def test_o1_black_op_stays_fp32():
+    x = paddle.Tensor(np.random.rand(4, 8).astype(np.float32))
+    w = paddle.Tensor(np.random.rand(8, 8).astype(np.float32))
+    with amp.auto_cast(level="O1"):
+        h = paddle.matmul(x, w)           # -> bf16
+        s = paddle.nn.functional.softmax(h)  # black: cast back to f32
+    assert str(s._data.dtype) == "float32"
+
+
+def test_o1_grads_cast_back_to_param_dtype():
+    w = paddle.Tensor(np.random.rand(8, 4).astype(np.float32),
+                      stop_gradient=False)
+    x = paddle.Tensor(np.random.rand(2, 8).astype(np.float32))
+    with amp.auto_cast(level="O1"):
+        loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    assert w.grad is not None
+    assert str(w.grad._data.dtype) == "float32"
+
+
+def test_o1_gray_promote():
+    x = paddle.Tensor(np.random.rand(4, 4).astype(np.float32))
+    y = paddle.Tensor(np.random.rand(4, 4).astype(np.float32))
+    with amp.auto_cast(level="O1"):
+        h = paddle.matmul(x, y)  # bf16
+        z = h + x                # gray op with mixed bf16/f32 -> promote f32
+    assert str(z._data.dtype) == "float32"
+
+
+def test_custom_lists():
+    x = paddle.Tensor(np.random.rand(4, 4).astype(np.float32))
+    y = paddle.Tensor(np.random.rand(4, 4).astype(np.float32))
+    with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+        out = paddle.matmul(x, y)
+    assert str(out._data.dtype) == "float32"
+    with pytest.raises(ValueError):
+        amp.AutoMixedPrecisionLists(custom_white_list={"softmax"},
+                                    custom_black_list={"softmax"})
+
+
+def test_o2_decorate_casts_params_keeps_norms_fp32():
+    model = nn.Sequential(
+        nn.Linear(8, 8),
+        nn.LayerNorm(8),
+        nn.Linear(8, 4),
+    )
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    lin_w = model[0].weight
+    ln_w = model[1].weight
+    assert str(lin_w._data.dtype) == "bfloat16"
+    assert str(ln_w._data.dtype) == "float32"
+    assert opt._use_master_weights
+
+    x = paddle.Tensor(np.random.rand(2, 8).astype(np.float32))
+    with amp.auto_cast(level="O2"):
+        out = model(x)
+        loss = out.sum()
+    loss.backward()
+    opt.step()
+    # master weights exist for the bf16 params
+    assert any(str(np.dtype(v.dtype)) == "float32"
+               for v in opt._master_weights.values())
+
+
+def test_grad_scaler_normal_step():
+    w = paddle.Tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    w.persistable = True
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.Tensor(np.ones((2, 4), np.float32))
+    with amp.auto_cast(level="O1"):
+        loss = paddle.matmul(x, w).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    before = np.asarray(w._data).copy()
+    scaler.step(opt)
+    scaler.update()
+    after = np.asarray(w._data)
+    assert not np.allclose(before, after)
+    # unscaled grad should be ~2.0 (sum over batch), not 2.0*1024
+    g = np.asarray(w.grad._data, np.float32)
+    np.testing.assert_allclose(g, np.full((4, 4), 2.0), rtol=2e-2)
+
+
+def test_grad_scaler_skips_on_inf_and_decays_scale():
+    w = paddle.Tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=1024.0, decr_ratio=0.5,
+                            decr_every_n_nan_or_inf=1)
+    x = paddle.Tensor(np.full((1, 2), np.inf, np.float32))
+    loss = paddle.matmul(x, w).sum()
+    scaler.scale(loss).backward()
+    before = np.asarray(w._data).copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_array_equal(before, np.asarray(w._data))  # skipped
+    assert scaler.get_loss_scaling() == 512.0
+
+
+def test_scaler_minimize_and_state_dict():
+    w = paddle.Tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=256.0)
+    x = paddle.Tensor(np.ones((1, 2), np.float32))
+    loss = paddle.matmul(x, w).sum()
+    scaler.scale(loss).backward()
+    scaler.minimize(opt, loss)
+    sd = scaler.state_dict()
+    s2 = amp.GradScaler()
+    s2.load_state_dict(sd)
+    assert s2.get_loss_scaling() == scaler.get_loss_scaling()
+
+
+def test_operator_stats_collection(capsys):
+    x = paddle.Tensor(np.random.rand(4, 4).astype(np.float32))
+    with amp.debugging.collect_operator_stats():
+        with amp.auto_cast(level="O1"):
+            paddle.matmul(x, x)
+        stats = amp.debugging.operator_stats()
+        assert stats["matmul"]["bfloat16"] >= 1
+    out = capsys.readouterr().out
+    assert "matmul" in out
+
+
+def test_bf16_supported():
+    assert amp.is_bfloat16_supported()
