@@ -22,6 +22,7 @@ WHITE_LIST = {
     "conv3d_transpose", "conv3d_transpose_nobias",
     "sdpa", "sdpa_mask", "fa_probs", "flash_attn_unpadded",
     "flash_attention", "multi_dot2",
+    "pallas_flash", "varlen_mea", "varlen_mea_mask",  # Pallas/varlen aliases
 }
 
 # Numerically sensitive ops: force float32 compute under AMP.
@@ -37,7 +38,7 @@ BLACK_LIST = {
     "gaussian_nll", "poisson_nll", "log_loss",
     "layer_norm", "layer_norm_nob", "layer_norm_now", "layer_norm_nowb",
     "group_norm", "group_norm_nowb", "instance_norm", "instance_norm_nowb",
-    "batch_norm_train", "batch_norm_eval", "rms_norm",
+    "batch_norm_train", "batch_norm_eval", "rms_norm", "pallas_rms_norm",
     "local_response_norm", "fn_normalize",
 }
 

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — the distributed stack (SURVEY.md §2.6, §5.8).
+
+Reference surface `python/paddle/distributed/*` rebuilt TPU-native: process
+meshes map to `jax.sharding.Mesh`, DistTensors are GSPMD-sharded global
+arrays, eager collectives are jitted XLA programs over ICI/DCN, rendezvous is
+the JAX coordination service.
+"""
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (ShardingStage1, ShardingStage2,  # noqa: F401
+                            ShardingStage3, dtensor_from_local,
+                            dtensor_to_local, reshard, shard_dataloader,
+                            shard_layer, shard_optimizer, shard_tensor,
+                            unshard_dtensor)
+from .communication import *  # noqa: F401,F403
+from .communication import stream  # noqa: F401
+from .communication.group import (Group, destroy_process_group,  # noqa: F401
+                                  get_backend, get_group, is_initialized,
+                                  new_group)
+from .parallel import (DataParallel, ParallelEnv, get_rank,  # noqa: F401
+                       get_world_size, init_parallel_env, is_available)
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+
+__all__ = [
+    "ProcessMesh", "get_mesh", "set_mesh", "Shard", "Replicate", "Partial",
+    "Placement", "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "shard_dataloader",
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "DataParallel", "new_group", "get_group", "Group", "is_initialized",
+    "destroy_process_group", "get_backend",
+    # collectives (from communication)
+    "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
+    "scatter_object_list", "alltoall", "alltoall_single", "send", "recv",
+    "isend", "irecv", "gather", "barrier", "ReduceOp", "P2POp",
+    "batch_isend_irecv", "stream", "wait",
+]

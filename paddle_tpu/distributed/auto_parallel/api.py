@@ -1,0 +1,399 @@
+"""Semi-auto parallel dygraph API.
+
+TPU-native analog of `python/paddle/distributed/auto_parallel/api.py`:
+`shard_tensor:181`, `reshard:703`, `shard_layer:804`, `shard_optimizer:1512`,
+`dtensor_from_local:617`, ShardingStage1/2/3 (`:1273,1334,1420`).
+
+The mechanism differs by design (SURVEY.md §7.1): a DistTensor is an eager
+Tensor whose buffer is a *global* `jax.Array` carrying a `NamedSharding`;
+every eager op compiled over it propagates shardings through XLA GSPMD — the
+role of the reference's 101 C++ SPMD rules (`phi/infermeta/spmd_rules/`) — and
+`reshard` is `jax.device_put`, which XLA lowers to the collective program the
+reference's reshard functions hand-code.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ..placement import Partial, Placement, Replicate, Shard
+from ..process_mesh import ProcessMesh, get_mesh
+from . import sharding_bridge as sb
+
+__all__ = ["shard_tensor", "reshard", "dtensor_from_local", "dtensor_to_local",
+           "unshard_dtensor", "shard_layer", "shard_optimizer",
+           "ShardingStage1", "ShardingStage2", "ShardingStage3",
+           "placements_of", "process_mesh_of", "is_dist_tensor",
+           "shard_dataloader", "ShardDataloader"]
+
+
+# ---------------------------------------------------------------------------
+# introspection helpers (Tensor.process_mesh / placements analogs)
+# ---------------------------------------------------------------------------
+
+def is_dist_tensor(t: Tensor) -> bool:
+    if getattr(t, "_dist_meta", None) is not None:
+        return True
+    return sb.infer_meta_from_array(t._data) is not None
+
+
+def _meta_of(t: Tensor) -> Optional[sb.DistMeta]:
+    if getattr(t, "_dist_meta", None) is not None:
+        return t._dist_meta
+    return sb.infer_meta_from_array(t._data)
+
+
+def placements_of(t: Tensor):
+    m = _meta_of(t)
+    return list(m.placements) if m else None
+
+
+def process_mesh_of(t: Tensor):
+    m = _meta_of(t)
+    return m.mesh if m else None
+
+
+# ---------------------------------------------------------------------------
+# shard_tensor / reshard
+# ---------------------------------------------------------------------------
+
+def _normalize_placements(mesh: ProcessMesh, placements):
+    if placements is None:
+        return [Replicate() for _ in range(mesh.ndim)]
+    placements = list(placements)
+    while len(placements) < mesh.ndim:
+        placements.append(Replicate())
+    return placements
+
+
+def _device_put_sharded(arr, mesh: ProcessMesh, placements, ndim):
+    import jax
+
+    return jax.device_put(arr, sb.named_sharding(mesh, placements, ndim))
+
+
+dispatch.register_op(
+    "dist_reshard",
+    lambda x, *, sharding: __import__("jax").device_put(x, sharding))
+
+
+def shard_tensor(data, mesh: Optional[ProcessMesh] = None, placements=None,
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Distribute `data` over `mesh` with `placements`
+    (reference `dist.shard_tensor`, `auto_parallel/api.py:181`)."""
+    import jax.numpy as jnp
+
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("no mesh given and no global mesh set "
+                         "(dist.auto_parallel.set_mesh)")
+    placements = _normalize_placements(mesh, placements)
+    src = data if isinstance(data, Tensor) else Tensor(data)
+    if dtype is not None:
+        from ...framework import dtype as dtype_mod
+
+        src = Tensor(src._data.astype(dtype_mod.to_np(dtype)),
+                     stop_gradient=src.stop_gradient)
+    sg = src.stop_gradient if stop_gradient is None else stop_gradient
+
+    has_partial = any(p.is_partial() for p in placements)
+    if has_partial:
+        arr = sb.expand_partial(src._data, mesh, placements)
+        arr = _device_put_sharded(arr, mesh, placements, src.ndim)
+        out = Tensor(arr, stop_gradient=True)
+        out._dist_meta = sb.DistMeta(mesh, placements)
+        out.stop_gradient = sg
+        return out
+
+    sharding = sb.named_sharding(mesh, placements, src.ndim)
+    if not sg and src._grad_node is not None:
+        # differentiable path: device_put through dispatch so the autograd
+        # graph records the (identity-transpose) reshard
+        out = dispatch.apply("dist_reshard", [src], {"sharding": sharding})
+    else:
+        out = Tensor(_device_put_sharded(src._data, mesh, placements,
+                                         src.ndim), stop_gradient=sg)
+    out.stop_gradient = sg
+    out._dist_meta = sb.DistMeta(mesh, placements)
+    if isinstance(data, Tensor):
+        out.name = data.name
+        out.persistable = data.persistable
+    return out
+
+
+def reshard(dist_tensor: Tensor, mesh: Optional[ProcessMesh] = None,
+            placements=None) -> Tensor:
+    """Convert placements (reference `dist.reshard`, `api.py:703`; engine
+    `phi/core/distributed/auto_parallel/reshard/`). All pairwise cases
+    (r↔s, p→r, p→s, s→s', cross-mesh) funnel through hidden-axis reduction +
+    `jax.device_put`."""
+    mesh = mesh or process_mesh_of(dist_tensor) or get_mesh()
+    placements = _normalize_placements(mesh, placements)
+    src_meta = _meta_of(dist_tensor)
+    arr = dist_tensor._data
+    sg = dist_tensor.stop_gradient
+
+    if src_meta is not None and src_meta.partial_dims:
+        arr = sb.reduce_partial(arr, src_meta)  # Partial -> Replicate first
+
+    if any(p.is_partial() for p in placements):
+        arr = sb.expand_partial(arr, mesh, placements)
+        arr = _device_put_sharded(arr, mesh, placements,
+                                  arr.ndim - len([p for p in placements
+                                                  if p.is_partial()]))
+        out = Tensor(arr, stop_gradient=True)
+        out._dist_meta = sb.DistMeta(mesh, placements)
+        out.stop_gradient = sg
+        return out
+
+    sharding = sb.named_sharding(mesh, placements, np.ndim(arr))
+    if not sg and (dist_tensor._grad_node is not None
+                   or not dist_tensor.stop_gradient):
+        carrier = dist_tensor if arr is dist_tensor._data else Tensor(arr)
+        if arr is not dist_tensor._data:
+            carrier.stop_gradient = True  # partial reduce broke the tape
+        out = dispatch.apply("dist_reshard", [carrier], {"sharding": sharding})
+    else:
+        import jax
+
+        out = Tensor(jax.device_put(arr, sharding), stop_gradient=sg)
+    out.stop_gradient = sg
+    out._dist_meta = sb.DistMeta(mesh, placements)
+    return out
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """Assemble a DistTensor from this process's local shard (reference
+    `dist.dtensor_from_local`, `api.py:617`).
+
+    Single-controller semantics: every addressable device in the mesh
+    receives `local_tensor` as its shard; under multi-process SPMD each
+    process contributes the shards of its own addressable devices.
+    """
+    import jax
+
+    placements = _normalize_placements(mesh, placements)
+    if any(p.is_partial() for p in placements):
+        raise NotImplementedError("dtensor_from_local with Partial: reshard "
+                                  "after assembly instead")
+    local = local_tensor._data if isinstance(local_tensor, Tensor) \
+        else jax.numpy.asarray(local_tensor)
+    gshape = list(local.shape)
+    for i, p in enumerate(placements):
+        if isinstance(p, Shard):
+            gshape[p.dim] *= mesh.shape[i]
+    sharding = sb.named_sharding(mesh, placements, len(gshape))
+    jmesh = mesh.to_jax_mesh()
+    local_np = np.asarray(local)
+    arrays = [jax.device_put(local_np, d)
+              for d in jmesh.devices.flatten()
+              if d.process_index == jax.process_index()]
+    arr = jax.make_array_from_single_device_arrays(tuple(gshape), sharding,
+                                                   arrays)
+    out = Tensor(arr, stop_gradient=getattr(local_tensor, "stop_gradient",
+                                            True))
+    out._dist_meta = sb.DistMeta(mesh, placements)
+    return out
+
+
+def dtensor_to_local(dist_tensor: Tensor, mesh=None, placements=None) -> Tensor:
+    """This process's local shard (reference `dist.dtensor_to_local`)."""
+    shards = dist_tensor._data.addressable_shards
+    return Tensor(np.asarray(shards[0].data))
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """Gather to a fully replicated dense tensor (reference
+    `dist.unshard_dtensor`)."""
+    meta = _meta_of(dist_tensor)
+    if meta is None:
+        return dist_tensor
+    rep = reshard(dist_tensor, meta.mesh,
+                  [Replicate() for _ in range(meta.mesh.ndim)])
+    out = Tensor(rep._data, stop_gradient=dist_tensor.stop_gradient)
+    out._dist_meta = None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shard_layer / shard_optimizer (ZeRO placement strategies)
+# ---------------------------------------------------------------------------
+
+def _shard_param_inplace(p, mesh, placements):
+    new = shard_tensor(Tensor(p._data), mesh, placements, stop_gradient=False)
+    p._data = new._data
+    p._dist_meta = new._dist_meta
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard every parameter of `layer` over `process_mesh` (reference
+    `dist.shard_layer`, `api.py:804`). `shard_fn(name, layer, mesh)` customises
+    per-sublayer placements; default replicates."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for p in sublayer.parameters(include_sublayers=False):
+                _shard_param_inplace(
+                    p, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+class _ShardingStageBase:
+    """Optimizer-state placement rewriters (reference ShardingStage1/2/3,
+    `auto_parallel/api.py:1273-1420` — the semi-auto face of ZeRO;
+    GSPMD-sharded states instead of hand-bucketed comm, SURVEY.md §7.3.3)."""
+
+    def __init__(self, mesh=None, sharding_mesh_dim=None):
+        self._mesh = mesh
+        self._dim = sharding_mesh_dim
+
+    def _axis(self, mesh: ProcessMesh):
+        if self._dim is None:
+            return 0 if mesh.ndim == 1 else mesh.dim_names.index("dp") \
+                if "dp" in mesh.dim_names else 0
+        if isinstance(self._dim, str):
+            return mesh.dim_names.index(self._dim)
+        return self._dim
+
+    def _shard_spec_for(self, shape, mesh) -> Optional[List[Placement]]:
+        """Placements sharding dim0 over the sharding axis when divisible."""
+        axis = self._axis(mesh)
+        if not shape or shape[0] % mesh.shape[axis] != 0:
+            return None
+        placements: List[Placement] = [Replicate()] * mesh.ndim
+        placements[axis] = Shard(0)
+        return placements
+
+
+class ShardingStage1(_ShardingStageBase):
+    """Shard optimizer states (accumulators) over the sharding axis."""
+
+    shard_param = False
+    shard_acc = True
+
+
+class ShardingStage2(ShardingStage1):
+    """Stage 2 = stage 1 states + sharded gradients. In the single-program
+    GSPMD design gradients inherit the accumulator sharding inside the jitted
+    step, so the eager placement rewrite is the same as stage 1 (the
+    distinction matters for the bucketed-NCCL design, not here)."""
+
+
+class ShardingStage3(_ShardingStageBase):
+    """Also shard the parameters themselves (ZeRO-3: gather-on-use is XLA's
+    job — GSPMD inserts the all-gathers where the weights are consumed)."""
+
+    shard_param = True
+    shard_acc = True
+
+
+class _ShardedOptimizer:
+    """Wraps an Optimizer so accumulators (and optionally params) are created
+    with distributed placements (reference `dist.shard_optimizer`,
+    `api.py:1512`)."""
+
+    def __init__(self, optimizer, shard_fn=None, mesh=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+        self._mesh = mesh or get_mesh()
+        if shard_fn is not None and getattr(shard_fn, "shard_param", False):
+            for p in optimizer._params:
+                if isinstance(p, Tensor):
+                    spec = shard_fn._shard_spec_for(list(p.shape), self._mesh)
+                    if spec is not None:
+                        _shard_param_inplace(p, self._mesh, spec)
+        orig_init = optimizer._init_acc
+
+        def sharded_init(name, p):
+            acc = orig_init(name, p)
+            mesh = self._mesh
+            if mesh is None or np.ndim(acc) == 0:
+                return acc
+            if self._shard_fn is not None:
+                spec = self._shard_fn._shard_spec_for(list(acc.shape), mesh)
+                if spec is not None:
+                    return _device_put_sharded(acc, mesh, spec, acc.ndim)
+                return acc
+            # default: follow the parameter's placements
+            meta = getattr(p, "_dist_meta", None) or \
+                sb.infer_meta_from_array(p._data)
+            if meta is not None and tuple(acc.shape) == tuple(p.shape):
+                return _device_put_sharded(acc, meta.mesh,
+                                           list(meta.placements), acc.ndim)
+            return acc
+
+        optimizer._init_acc = sharded_init
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __setattr__(self, item, value):
+        if item in ("_inner", "_shard_fn", "_mesh"):
+            object.__setattr__(self, item, value)
+        else:  # forward config writes (e.g. amp.decorate's master-weight flag)
+            setattr(self._inner, item, value)
+
+
+def shard_optimizer(optimizer, shard_fn=None, mesh=None):
+    return _ShardedOptimizer(optimizer, shard_fn, mesh)
+
+
+# ---------------------------------------------------------------------------
+# shard_dataloader
+# ---------------------------------------------------------------------------
+
+class ShardDataloader:
+    """Wrap a DataLoader so each batch is shard_tensor'd over the mesh
+    (reference `dist.shard_dataloader`, `api.py:3016`)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=0,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+        self._shard_dims = shard_dims
+        self._input_keys = input_keys
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _shard_item(self, item, dim):
+        if isinstance(item, Tensor):
+            placements: List[Placement] = [Replicate()] * self._mesh.ndim
+            if dim is not None:
+                axis = 0 if self._mesh.ndim == 1 else (
+                    self._mesh.dim_names.index("dp")
+                    if "dp" in self._mesh.dim_names else 0)
+                placements[axis] = Shard(dim)
+            return shard_tensor(item, self._mesh, placements)
+        return item
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: self._shard_item(v, self._shard_dims)
+                       for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._shard_item(v, self._shard_dims)
+                                  for v in batch)
+            else:
+                yield self._shard_item(batch, self._shard_dims)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=0,
+                     is_dataset_splitted=False) -> ShardDataloader:
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                          is_dataset_splitted)

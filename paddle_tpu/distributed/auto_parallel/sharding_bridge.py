@@ -1,0 +1,158 @@
+"""ProcessMesh/Placement ⇄ jax.sharding translation + the reshard engine.
+
+This is the TPU-native replacement for the reference's reshard function
+registry (`phi/core/distributed/auto_parallel/reshard/
+reshard_function_registry.cc` and the 16 pairwise conversion files): instead
+of hand-written collective programs per (src, dst) placement pair, a
+distributed tensor is a global `jax.Array` with a `NamedSharding`, and every
+conversion is `jax.device_put` to the target sharding — XLA GSPMD emits the
+all-gather / all-to-all / slice programs over ICI/DCN.
+
+Partial placements (`Partial(sum)` etc., reference `placement_types.h`) are
+represented by a *hidden stacked axis*: a tensor partial over mesh dim k
+stores per-rank contributions in an extra leading dim of size mesh.shape[k],
+sharded over that mesh axis. Reducing the hidden axis (one XLA reduce =
+all-reduce over the mesh axis) converts Partial → Replicate.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..placement import Partial, Placement, Replicate, Shard
+from ..process_mesh import ProcessMesh
+
+
+class DistMeta:
+    """Tensor-side distributed attribute (analog of `TensorDistAttr`,
+    `phi/core/distributed/auto_parallel/dist_attr.h`)."""
+
+    __slots__ = ("mesh", "placements")
+
+    def __init__(self, mesh: ProcessMesh, placements: Sequence[Placement]):
+        if len(placements) != mesh.ndim:
+            raise ValueError(
+                f"need {mesh.ndim} placements for mesh {mesh.shape}, got "
+                f"{len(placements)}")
+        self.mesh = mesh
+        self.placements = tuple(placements)
+
+    @property
+    def partial_dims(self) -> List[int]:
+        return [i for i, p in enumerate(self.placements) if p.is_partial()]
+
+    def __eq__(self, other):
+        return (isinstance(other, DistMeta) and self.mesh == other.mesh
+                and self.placements == other.placements)
+
+    def __repr__(self):
+        return f"DistMeta(mesh={self.mesh.shape}, placements={self.placements})"
+
+
+def partition_spec(mesh: ProcessMesh, placements: Sequence[Placement],
+                   ndim: int):
+    """PartitionSpec for the *stored* array (hidden partial dims first)."""
+    from jax.sharding import PartitionSpec as P
+
+    partial_axes = [mesh.dim_names[i] for i, p in enumerate(placements)
+                    if p.is_partial()]
+    dim_axes: List[list] = [[] for _ in range(ndim)]
+    for i, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim if p.dim >= 0 else p.dim + ndim
+            if d >= ndim:
+                raise ValueError(f"Shard({p.dim}) out of range for ndim {ndim}")
+            dim_axes[d].append(mesh.dim_names[i])
+    spec = [ax for ax in partial_axes]
+    for axes in dim_axes:
+        if not axes:
+            spec.append(None)
+        elif len(axes) == 1:
+            spec.append(axes[0])
+        else:
+            spec.append(tuple(axes))
+    return P(*spec)
+
+
+def named_sharding(mesh: ProcessMesh, placements: Sequence[Placement],
+                   ndim: int):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh.to_jax_mesh(),
+                         partition_spec(mesh, placements, ndim))
+
+
+def stored_shape(global_shape: Tuple[int, ...], mesh: ProcessMesh,
+                 placements: Sequence[Placement]) -> Tuple[int, ...]:
+    hidden = tuple(mesh.shape[i] for i, p in enumerate(placements)
+                   if p.is_partial())
+    return hidden + tuple(global_shape)
+
+
+def logical_shape(stored: Tuple[int, ...], meta: DistMeta) -> Tuple[int, ...]:
+    return tuple(stored[len(meta.partial_dims):])
+
+
+_NEUTRAL = {"sum": 0.0, "avg": 0.0, "max": None, "min": None, "prod": 1.0,
+            "any": 0.0, "all": 1.0}
+
+
+def expand_partial(arr, mesh: ProcessMesh, placements):
+    """Give `arr` (logical value) the hidden stacked dims for its Partial
+    placements: slot 0 carries the value, other slots the reduction-neutral
+    element (so an immediate Partial→Replicate round-trips)."""
+    import jax.numpy as jnp
+
+    for i in reversed([i for i, p in enumerate(placements) if p.is_partial()]):
+        size = mesh.shape[i]
+        neutral = _NEUTRAL[placements[i].reduce_type]
+        if neutral is None:  # max/min: replicate the value (idempotent)
+            arr = jnp.broadcast_to(arr[None], (size,) + arr.shape)
+        else:
+            rest = jnp.full((size - 1,) + arr.shape, neutral, arr.dtype)
+            arr = jnp.concatenate([arr[None], rest], axis=0)
+    return arr
+
+
+def reduce_partial(arr, meta: DistMeta):
+    """Reduce all hidden stacked dims (Partial → Replicate). One XLA reduce
+    per partial axis = all-reduce over that mesh axis."""
+    import jax.numpy as jnp
+
+    red = {
+        "sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min,
+        "prod": jnp.prod,
+        "any": lambda a, axis: jnp.any(a, axis=axis).astype(a.dtype),
+        "all": lambda a, axis: jnp.all(a, axis=axis).astype(a.dtype),
+    }
+    kinds = [meta.placements[i].reduce_type for i in meta.partial_dims]
+    for kind in reversed(kinds):
+        arr = red[kind](arr, axis=0)
+    return arr
+
+
+def infer_meta_from_array(arr) -> "DistMeta | None":
+    """Best-effort DistMeta from a jax.Array's NamedSharding (no partials —
+    those always carry explicit meta)."""
+    try:
+        from jax.sharding import NamedSharding
+    except ImportError:  # pragma: no cover
+        return None
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    jm = sh.mesh
+    mesh = ProcessMesh(
+        np.arange(int(np.prod(jm.devices.shape))).reshape(jm.devices.shape),
+        list(jm.axis_names))
+    # map spec entries back to placements
+    placements: List[Placement] = [Replicate() for _ in range(mesh.ndim)]
+    spec = sh.spec
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[mesh.dim_names.index(ax)] = Shard(d)
+    return DistMeta(mesh, placements)

@@ -1,0 +1,415 @@
+"""Eager collectives over XLA (the ProcessGroupXLA of SURVEY.md §5.8).
+
+API parity with `python/paddle/distributed/communication/` (all_reduce,
+all_gather, broadcast, reduce, scatter, reduce_scatter, alltoall, send/recv,
+barrier + *_object variants). Reference backends (NCCL/Gloo/MPI/BKCL/XCCL,
+§2.6) collapse to one: tiny jitted XLA programs over the group's device mesh,
+compiled once per (op, shape, dtype, group) and riding ICI/DCN.
+
+Single-controller convention: a tensor participating in an eager collective is
+the *stack of per-rank values* — shape [nranks, ...local], ideally sharded
+over the group axis (a plain replicated tensor means "every rank holds this
+same value", and is auto-broadcast to the stack). This is exactly the
+information content of the reference's one-local-tensor-per-process model,
+expressed as one global array.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .group import Group, _get_global_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "broadcast", "broadcast_object_list", "reduce", "reduce_scatter",
+           "scatter", "scatter_object_list", "alltoall", "alltoall_single",
+           "send", "recv", "isend", "irecv", "gather", "barrier",
+           "P2POp", "batch_isend_irecv", "wait"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    "sum": lambda x, axis: x.sum(axis),
+    "avg": lambda x, axis: x.mean(axis),
+    "max": lambda x, axis: x.max(axis),
+    "min": lambda x, axis: x.min(axis),
+    "prod": lambda x, axis: x.prod(axis),
+}
+
+
+def _group(group) -> Group:
+    return group if group is not None else _get_global_group()
+
+
+def _group_sharding(g: Group, ndim_rest: int):
+    """NamedSharding stacking dim0 over the group's devices."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(g.to_jax_mesh(), P("g", *([None] * ndim_rest)))
+
+
+def _as_stack(t: Tensor, g: Group):
+    """[nranks, ...] stacked view of the tensor's per-rank values."""
+    import jax.numpy as jnp
+
+    arr = t._data
+    if arr.ndim >= 1 and arr.shape[0] == g.nranks and _is_stacked(t):
+        return arr, True
+    return jnp.broadcast_to(arr[None], (g.nranks,) + arr.shape), False
+
+
+def _is_stacked(t: Tensor) -> bool:
+    """A tensor is treated as rank-stacked when its dim0 is sharded (or it
+    was produced by a collective that marked it)."""
+    if getattr(t, "_rank_stacked", False):
+        return True
+    try:
+        from jax.sharding import NamedSharding
+
+        sh = t._data.sharding
+        return isinstance(sh, NamedSharding) and len(sh.spec) > 0 and \
+            sh.spec[0] is not None
+    except Exception:
+        return False
+
+
+def _mark_stacked(t: Tensor) -> Tensor:
+    t.__dict__["_rank_stacked"] = True
+    return t
+
+
+@functools.lru_cache(maxsize=512)
+def _compiled(kind: str, gid: int, shape, dtype, extra):
+    """One compiled collective program per (op, group, aval)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .group import get_group
+
+    g = _get_global_group() if gid == 0 else get_group(gid)
+    out_sharding = _group_sharding(g, len(shape) - 1)
+
+    if kind == "all_reduce":
+        red = _REDUCERS[extra]
+
+        def fn(x):
+            return jnp.broadcast_to(red(x, 0)[None], x.shape)
+    elif kind == "reduce":
+        red, dst = extra
+
+        def fn(x):
+            r = _REDUCERS[red](x, 0)
+            return x.at[dst].set(r)
+    elif kind == "broadcast":
+        src = extra
+
+        def fn(x):
+            return jnp.broadcast_to(x[src][None], x.shape)
+    elif kind == "reduce_scatter":
+        red = extra
+        n = g.nranks
+
+        def fn(x):
+            # x: [n, n*chunk, ...] per-rank inputs; out[r] = sum_r' x[r', r]
+            r = _REDUCERS[red](x, 0)                    # [n*chunk, ...]
+            return r.reshape((n, -1) + r.shape[1:]) if r.ndim >= 1 else r
+    elif kind == "alltoall":
+        n = g.nranks
+
+        def fn(x):
+            # x: [n, n*chunk, ...]; out[r] = concat_r'(x[r', r-th chunk])
+            chunks = x.reshape((n, n, -1) + x.shape[2:])
+            return jnp.swapaxes(chunks, 0, 1).reshape(x.shape)
+    elif kind == "shift":  # ring p2p: out[r] = x[(r - offset) % n]
+        offset = extra
+        n = g.nranks
+
+        def fn(x):
+            return jnp.roll(x, offset, axis=0)
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    return jax.jit(fn, out_shardings=out_sharding)
+
+
+def _run(kind, t: Tensor, group, extra=None, in_place=True):
+    g = _group(group)
+    stacked, was_stacked = _as_stack(t, g)
+    key_shape = tuple(int(s) for s in stacked.shape)
+    fn = _compiled(kind, g.id, key_shape, str(stacked.dtype), extra)
+    out = fn(stacked)
+    if in_place:
+        t._data = out if was_stacked else out[0]
+        if was_stacked:
+            _mark_stacked(t)
+        return t
+    res = Tensor(out if was_stacked else out[0], stop_gradient=True)
+    if was_stacked:
+        _mark_stacked(res)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce (reference `dist.all_reduce`,
+    `python/paddle/distributed/communication/all_reduce.py`)."""
+    return _FinishedTask(_run("all_reduce", tensor, group, extra=op))
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    return _FinishedTask(_run("reduce", tensor, group,
+                              extra=(op, g.get_group_rank(dst)
+                                     if g.get_group_rank(dst) >= 0 else dst)))
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    src_local = g.get_group_rank(src)
+    return _FinishedTask(_run("broadcast", tensor, group,
+                              extra=src_local if src_local >= 0 else src))
+
+
+def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
+               group=None, sync_op=True):
+    """Gather per-rank values; fills `tensor_list` with nranks Tensors
+    (reference `dist.all_gather`)."""
+    g = _group(group)
+    stacked, _ = _as_stack(tensor, g)
+    out = [Tensor(stacked[i]) for i in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(out)
+    return out
+
+
+def gather(tensor: Tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    return all_gather(gather_list, tensor, group, sync_op)
+
+
+def scatter(tensor: Tensor, tensor_list: Optional[List[Tensor]] = None,
+            src=0, group=None, sync_op=True):
+    """Scatter `tensor_list` (on src) to ranks: the result is the per-rank
+    stack (reference `dist.scatter`)."""
+    import jax
+    import jax.numpy as jnp
+
+    g = _group(group)
+    if tensor_list:
+        stacked = jnp.stack([t._data if isinstance(t, Tensor)
+                             else jnp.asarray(t) for t in tensor_list])
+    else:
+        arr = tensor._data
+        stacked = arr.reshape((g.nranks, -1) + arr.shape[1:]) \
+            if arr.shape[0] % g.nranks == 0 else arr
+    stacked = jax.device_put(stacked, _group_sharding(g, stacked.ndim - 1))
+    tensor._data = stacked
+    _mark_stacked(tensor)
+    return _FinishedTask(tensor)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """Reduce the per-rank stacks then scatter chunks
+    (reference `dist.reduce_scatter`)."""
+    import jax.numpy as jnp
+
+    g = _group(group)
+    if tensor_list is not None:
+        src = Tensor(jnp.stack([t._data for t in tensor_list]))
+        src = _mark_stacked(src)
+    else:
+        src = tensor
+    # build [n, n*chunk, ...] stack: each rank's input is the full list concat
+    stacked, _ = _as_stack(src, g)
+    if tensor_list is not None:
+        # single-controller list path: every rank holds the same concat
+        stacked = jnp.broadcast_to(
+            stacked.reshape((1, stacked.shape[0] * stacked.shape[1])
+                            + stacked.shape[2:]),
+            (g.nranks, stacked.shape[0] * stacked.shape[1])
+            + stacked.shape[2:])
+    fn = _compiled("reduce_scatter", g.id,
+                   tuple(int(s) for s in stacked.shape), str(stacked.dtype),
+                   op)
+    out = fn(stacked)
+    tensor._data = out
+    _mark_stacked(tensor)
+    return _FinishedTask(tensor)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """All-to-all (reference `dist.alltoall`): rank r sends in[r][j] to rank
+    j. Inputs: list of nranks tensors (the per-destination chunks)."""
+    import jax.numpy as jnp
+
+    g = _group(group)
+    if isinstance(in_tensor_list, Tensor):
+        stacked, _ = _as_stack(in_tensor_list, g)
+    else:
+        per_rank = jnp.stack([t._data for t in in_tensor_list])  # [n, ...]
+        # single-controller: every rank sends the same chunk list
+        stacked = jnp.broadcast_to(
+            per_rank.reshape(1, -1, *per_rank.shape[2:]),
+            (g.nranks, per_rank.shape[0] * per_rank.shape[1],
+             *per_rank.shape[2:])) if per_rank.ndim > 1 else per_rank
+    fn = _compiled("alltoall", g.id, tuple(int(s) for s in stacked.shape),
+                   str(stacked.dtype), None)
+    out = fn(stacked)
+    chunks = out.reshape((g.nranks, g.nranks, -1) + out.shape[2:])
+    result = [Tensor(chunks[i, i]) for i in range(g.nranks)]
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(result)
+    return result
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _group(group)
+    t = in_tensor if isinstance(in_tensor, Tensor) else Tensor(in_tensor)
+    res = _run("alltoall", _mark_stacked(Tensor(t._data)) if
+               t._data.shape[0] == g.nranks else t, group, in_place=False)
+    out_tensor._data = res._data
+    return _FinishedTask(out_tensor)
+
+
+# -- p2p (single-controller mailbox + ring shift) ---------------------------
+
+_mailbox = {}
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send. Single-controller: the value is posted to an
+    in-process mailbox keyed (src_rank, dst_rank); `recv` collects it.
+    In-graph p2p (pipeline stages) uses `ppermute` via `p2p_shift`."""
+    import jax
+
+    src = jax.process_index()
+    _mailbox[(src, dst, _group(group).id)] = tensor._data
+    return _FinishedTask(tensor)
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    import jax
+
+    me = jax.process_index()
+    key = (src, me, _group(group).id)
+    if key not in _mailbox:
+        raise RuntimeError(
+            f"recv(src={src}): no matching send posted (group "
+            f"{_group(group).id}). In single-controller mode send() must "
+            f"run before the matching recv().")
+    tensor._data = _mailbox.pop(key)
+    return _FinishedTask(tensor)
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
+    return tasks
+
+
+def p2p_shift(tensor: Tensor, offset: int = 1, group=None) -> Tensor:
+    """Ring shift over the group axis (`ppermute`): out[r] = in[(r-offset)%n].
+    The in-graph p2p primitive pipeline schedules build on."""
+    return _run("shift", tensor, group, extra=int(offset), in_place=False)
+
+
+def barrier(group=None):
+    import jax
+
+    jax.effects_barrier()
+    for d in jax.devices():
+        pass
+    return _FinishedTask(None)
+
+
+def wait(tensor=None, group=None, use_calc_stream=True):
+    import jax
+
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data)
+
+
+# -- object collectives ------------------------------------------------------
+
+def all_gather_object(object_list: List, obj, group=None):
+    """Single-controller: every rank's object is this process's object."""
+    g = _group(group)
+    object_list.clear()
+    object_list.extend([obj] * g.nranks)
+
+
+def broadcast_object_list(object_list: List, src=0, group=None):
+    return object_list
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None, src=0,
+                        group=None):
+    import jax
+
+    me = jax.process_index()
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[me % len(in_object_list)])
+
+
+class _FinishedTask:
+    """Collective task handle (reference returns an async task;
+    XLA dispatch is async already, so wait() just blocks on the buffer)."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self):
+        import jax
+
+        if isinstance(self._result, Tensor):
+            jax.block_until_ready(self._result._data)
+
+    def is_completed(self):
+        return True
+
+
+class _StreamNS:
+    """`paddle.distributed.stream.*` parity: stream-ordered variants map to
+    the same XLA programs (dispatch is already stream-ordered per device)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,107 @@
+"""Process groups.
+
+Analog of the reference ProcessGroup layer (`phi/core/distributed/collective/
+process_group.h:126`, python `paddle.distributed.communication.group`). A
+group here is a set of device ranks over a 1-D jax sub-mesh ("g" axis); eager
+collectives compile tiny XLA programs over it (the "ProcessGroupXLA" of
+SURVEY.md §5.8) — rendezvous/TCPStore is replaced by the JAX/PJRT coordination
+service, which `jax.distributed.initialize` runs on multi-host.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_groups: Dict[int, "Group"] = {}
+_next_gid = [0]
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int, pg_name: str = ""):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.pg_name = pg_name or f"pg_{gid}"
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        """This process's rank inside the group (single-controller: the
+        process drives every device, so this is the process rank if it is a
+        member, else -1)."""
+        import jax
+
+        me = jax.process_index()
+        return self.ranks.index(me) if me in self.ranks else \
+            (0 if jax.process_count() == 1 else -1)
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        return True
+
+    @property
+    def process_group(self):
+        return self
+
+    def to_jax_mesh(self):
+        """1-D mesh over the group's devices, axis name 'g'."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        return Mesh(np.array([devices[r % len(devices)] for r in self.ranks]),
+                    ("g",))
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+def _register(group: Group):
+    _groups[group.id] = group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None, timeout=None
+              ) -> Group:
+    """Create a communication group (reference `dist.new_group`)."""
+    import jax
+
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    _next_gid[0] += 1
+    g = Group(sorted(ranks), _next_gid[0])
+    _register(g)
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def _get_global_group() -> Group:
+    if 0 not in _groups:
+        import jax
+
+        _groups[0] = Group(list(range(jax.device_count())), 0, "global")
+    return _groups[0]
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def is_initialized() -> bool:
+    return 0 in _groups
+
+
+def get_backend(group=None) -> str:
+    return "xla"

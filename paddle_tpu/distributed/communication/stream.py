@@ -1,0 +1,9 @@
+"""Stream-ordered collective variants (reference
+`python/paddle/distributed/communication/stream/`). XLA dispatch is already
+device-stream-ordered, so these alias the synchronous implementations."""
+from .collective import (all_gather, all_reduce, alltoall,  # noqa: F401
+                         alltoall_single, broadcast, recv, reduce,
+                         reduce_scatter, scatter, send)
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "recv", "reduce", "reduce_scatter", "scatter", "send"]

@@ -1,0 +1,169 @@
+"""Parallel environment + eager DataParallel.
+
+Analog of `python/paddle/distributed/parallel.py` (`init_parallel_env:978`,
+`DataParallel:219`). Rendezvous goes through the JAX/PJRT distributed
+coordination service (`jax.distributed.initialize`) instead of the
+reference's TCPStore + NCCL-id exchange (`tcp_store.h:121`); on a single
+controller it is a no-op and "ranks" are the mesh devices.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .communication.group import Group, _get_global_group, new_group
+from .process_mesh import ProcessMesh, get_mesh, set_mesh
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "DataParallel", "is_available"]
+
+_initialized = [False]
+
+
+def is_available() -> bool:
+    return True
+
+
+def init_parallel_env() -> Optional[Group]:
+    """Initialise the distributed runtime (reference
+    `dist.init_parallel_env`, `parallel.py:978`).
+
+    Multi-host: honours the launch env contract (PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_MASTER) by bringing up the JAX coordination
+    service. Single-host: establishes the global group over all devices.
+    """
+    if _initialized[0]:
+        return _get_global_group()
+    import jax
+
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    master = os.environ.get("PADDLE_MASTER")
+    if n_procs > 1 and master and jax.process_count() == 1:
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=n_procs, process_id=proc_id)
+    if get_mesh() is None:
+        set_mesh(ProcessMesh(np.arange(jax.device_count()), ["world"]))
+    _initialized[0] = True
+    return _get_global_group()
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    import jax
+
+    if group is not None:
+        return group.rank
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    import jax
+
+    if group is not None:
+        return group.nranks
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Env snapshot (reference `paddle.distributed.ParallelEnv`)."""
+
+    def __init__(self):
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.device_id = self.rank
+        self.dev_id = self.rank
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+class DataParallel:
+    """Eager data-parallel model wrapper (reference `DataParallel`,
+    `parallel.py:219` + `EagerReducer` `fluid/distributed/collective/
+    reducer.h:88`).
+
+    TPU-native design: instead of hook-driven bucketed all-reduce, the wrapper
+    shards each input batch over the 'dp' (or sole) mesh axis; gradients of
+    replicated parameters come out of the XLA program already all-reduced
+    (GSPMD inserts the psum), overlapping communication with the backward
+    automatically via XLA's latency-hiding scheduler. comm_buffer_size_MB /
+    find_unused_parameters are accepted for API parity (no-ops here).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh: Optional[ProcessMesh] = None,
+                 shard_input: bool = True):
+        from .auto_parallel.api import shard_tensor
+        from .placement import Replicate
+
+        self._layers = layers
+        self._mesh = mesh or get_mesh()
+        self._shard_input = shard_input
+        if self._mesh is not None:
+            # replicate parameters over the mesh (explicit placement commits
+            # them so GSPMD treats grads as partial->allreduce)
+            placements = [Replicate() for _ in range(self._mesh.ndim)]
+            for p in layers.parameters():
+                st = shard_tensor(Tensor(p._data), self._mesh, placements)
+                p._data = st._data
+                p._dist_meta = st._dist_meta
+
+    def _dp_axis(self):
+        names = self._mesh.dim_names
+        return names.index("dp") if "dp" in names else 0
+
+    def forward(self, *inputs, **kwargs):
+        if self._mesh is not None and self._shard_input:
+            from .auto_parallel.api import shard_tensor
+            from .placement import Replicate, Shard
+
+            axis = self._dp_axis()
+
+            def shard_in(x):
+                if isinstance(x, Tensor) and x.ndim >= 1 and \
+                        x.shape[0] % self._mesh.shape[axis] == 0:
+                    placements = [Replicate()] * self._mesh.ndim
+                    placements[axis] = Shard(0)
+                    return shard_tensor(x, self._mesh, placements,
+                                        stop_gradient=x.stop_gradient)
+                return x
+
+            inputs = tuple(shard_in(x) for x in inputs)
+            kwargs = {k: shard_in(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

@@ -47,7 +47,11 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, bias=None, residual=None,
                    quant_scale=-1, **kwargs):
     """Fused RMSNorm (+optional pre-norm residual add), reference
-    `incubate.nn.functional.fused_rms_norm`. Returns (out, residual_out)."""
+    `incubate.nn.functional.fused_rms_norm`. Normalizes over axes
+    [begin_norm_axis:] (flattened for the kernel). Returns (out,
+    residual_out) when a residual is passed."""
+    from ....ops import manipulation
+
     x = as_tensor(x)
     if bias is not None:
         x = x + as_tensor(bias)
@@ -55,13 +59,28 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
         x = x + as_tensor(residual)
     residual_out = x if residual is not None else None
     w = as_tensor(norm_weight)
-    if _pallas_on(x) and _prms.supported(tuple(x.shape), x._data.dtype):
-        out = dispatch.apply("pallas_rms_norm", [x, w],
+
+    axis = begin_norm_axis if begin_norm_axis >= 0 else begin_norm_axis + x.ndim
+    orig_shape = list(x.shape)
+    flat = x
+    if axis < x.ndim - 1:  # flatten the normalized axes into one
+        lead = orig_shape[:axis]
+        flat = manipulation.reshape(x, lead + [-1])
+        w = manipulation.reshape(w, [-1])
+    if _pallas_on(flat) and _prms.supported(tuple(flat.shape),
+                                            flat._data.dtype):
+        out = dispatch.apply("pallas_rms_norm", [flat, w],
                              {"epsilon": float(epsilon)})
     else:
-        out = dispatch.apply("rms_norm", [x, w], {"epsilon": float(epsilon)})
+        out = dispatch.apply("rms_norm", [flat, w],
+                             {"epsilon": float(epsilon)})
     if norm_bias is not None:
-        out = out + as_tensor(norm_bias)
+        nb = as_tensor(norm_bias)
+        if axis < x.ndim - 1:
+            nb = manipulation.reshape(nb, [-1])
+        out = out + nb
+    if axis < x.ndim - 1:
+        out = manipulation.reshape(out, orig_shape)
     return (out, residual_out) if residual is not None else out
 
 
@@ -77,9 +96,37 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     if residual is not None:
         x = x + as_tensor(residual)
     residual_out = x if residual is not None else None
-    out = F.layer_norm(x, x.shape[-1:], weight=norm_weight, bias=norm_bias,
+    axis = begin_norm_axis if begin_norm_axis >= 0 else begin_norm_axis + x.ndim
+    out = F.layer_norm(x, x.shape[axis:], weight=norm_weight, bias=norm_bias,
                        epsilon=epsilon)
     return (out, residual_out) if residual is not None else out
+
+
+def _rope_generic_fn(x, cos, sin, neox, batched, offset):
+    """XLA rotation: x [B,S,H,D]; cos/sin [T,D/2] or [B,S,D/2] (batched)."""
+    import jax.numpy as jnp
+
+    s_len = x.shape[1]
+    if batched:
+        c = cos[:, :, None, :].astype(jnp.float32)
+        s = sin[:, :, None, :].astype(jnp.float32)
+    else:
+        c = cos[offset:offset + s_len][None, :, None, :].astype(jnp.float32)
+        s = sin[offset:offset + s_len][None, :, None, :].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    if neox:
+        d2 = x.shape[-1] // 2
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    else:  # GPT-J interleaved pairs (even, odd)
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+dispatch.register_op("rope_generic", _rope_generic_fn)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -89,9 +136,10 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     """Reference `incubate.nn.functional.fused_rotary_position_embedding`
     (kernel `phi/kernels/fusion/gpu/fused_rope_kernel.cu`).
 
-    q/k: [B, S, H, D]. cos/sin: [T, D/2] (half tables) or [T, D]/broadcastable
-    full tables (auto-halved). Rotates the (x[..., :D/2], x[..., D/2:]) pairs
-    (neox style).
+    q/k/v: [B, S, H, D] — every provided tensor is rotated (reference
+    semantics). cos/sin: [T, D/2] half tables or [T, D]/broadcastable full
+    tables (auto-halved). `position_ids` [B, S] gathers per-batch rows;
+    `use_neox_rotary_style=False` rotates interleaved (GPT-J) pairs.
     """
     import jax.numpy as jnp
 
@@ -99,6 +147,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     d = q.shape[-1]
     if cos is None or sin is None:
         t = max(q.shape[1] + offset, 1)
+        if position_ids is not None:
+            t = max(t, int(np.asarray(as_tensor(position_ids)._data).max()) + 1)
         inv = 1.0 / (rotary_emb_base **
                      (np.arange(0, d, 2, dtype=np.float64) / d))
         freqs = np.outer(np.arange(t, dtype=np.float64), inv)
@@ -112,23 +162,40 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if cos.shape[-1] == d:
         cos = Tensor(cos._data[..., : d // 2])
         sin = Tensor(sin._data[..., : d // 2])
-    single = k is None
-    if single:
-        k = q
-    k = as_tensor(k)
-    attrs = {"offset": int(offset)}
-    if (_pallas_on(q) and _prope.supported(tuple(q.shape), q._data.dtype)
-            and tuple(q.shape) == tuple(k.shape)):
-        oq, ok = dispatch.apply("pallas_rope", [q, k, cos, sin], attrs)
-    else:
-        from ....models import llama as _llama  # noqa: F401  registers fused_rope
 
-        oq, ok = dispatch.apply("fused_rope", [q, k, cos, sin], attrs)
-    if single:
-        return oq
+    batched = position_ids is not None
+    if batched:
+        pid = as_tensor(position_ids)
+        cos = Tensor(jnp.take(cos._data, pid._data, axis=0))  # [B,S,D/2]
+        sin = Tensor(jnp.take(sin._data, pid._data, axis=0))
+
+    tensors = [("q", q)]
+    if k is not None:
+        tensors.append(("k", as_tensor(k)))
     if v is not None:
-        return oq, ok, as_tensor(v)
-    return oq, ok
+        tensors.append(("v", as_tensor(v)))
+
+    use_pallas = (use_neox_rotary_style and not batched and _pallas_on(q)
+                  and _prope.supported(tuple(q.shape), q._data.dtype)
+                  and k is not None
+                  and tuple(q.shape) == tuple(as_tensor(k).shape))
+    outs = {}
+    if use_pallas:
+        oq, ok = dispatch.apply("pallas_rope",
+                                [q, as_tensor(k), cos, sin],
+                                {"offset": int(offset)})
+        outs["q"], outs["k"] = oq, ok
+        if v is not None:
+            outs["v"] = dispatch.apply(
+                "rope_generic", [as_tensor(v), cos, sin],
+                {"neox": True, "batched": False, "offset": int(offset)})
+    else:
+        attrs = {"neox": bool(use_neox_rotary_style), "batched": batched,
+                 "offset": int(offset)}
+        for name, t in tensors:
+            outs[name] = dispatch.apply("rope_generic", [t, cos, sin], attrs)
+    result = [outs[name] for name, _ in tensors]
+    return result[0] if len(result) == 1 else tuple(result)
 
 
 def swiglu(x, y=None, name=None):
@@ -208,7 +275,7 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
     sl, kl = as_tensor(seq_lens), as_tensor(kv_seq_lens)
 
-    def fn(q, k, v, sl, kl, scale, causal):
+    def fn(q, k, v, sl, kl, mask, scale, causal):
         import jax
 
         d = q.shape[-1]
@@ -223,13 +290,27 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                 (kpos[None, :] < kl.reshape(-1, 1, 1, 1)[:, :, 0, 0, None])
         valid = valid[:, None]
         if causal:
-            valid = valid & (qpos[:, None] >= kpos[None, :])[None, None]
+            # bottom-right aligned (decode: sq=1 attends to all cached kv)
+            valid = valid & (qpos[:, None] + (skv - sq) >=
+                             kpos[None, :])[None, None]
         scores = jnp.where(valid, scores, -1e30)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, -1e30)
+            else:
+                scores = scores + mask.astype(scores.dtype)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         return jnp.einsum("bhst,bhtd->bhsd", probs, v)
 
+    attrs = {"scale": scale, "causal": bool(causal)}
+    if mask is not None:
+        op = "varlen_mea_mask"
+        if op not in dispatch.op_registry():
+            dispatch.register_op(
+                op, lambda q, k, v, sl, kl, m, **a: fn(q, k, v, sl, kl, m, **a))
+        return dispatch.apply(op, [q, k, v, sl, kl, as_tensor(mask)], attrs)
     op = "varlen_mea"
     if op not in dispatch.op_registry():
-        dispatch.register_op(op, fn)
-    return dispatch.apply(op, [q, k, v, sl, kl],
-                          {"scale": scale, "causal": bool(causal)})
+        dispatch.register_op(
+            op, lambda q, k, v, sl, kl, **a: fn(q, k, v, sl, kl, None, **a))
+    return dispatch.apply(op, [q, k, v, sl, kl], attrs)

@@ -240,11 +240,11 @@ class LlamaForCausalLM(nn.Layer):
         6*N_params + 12*L*H*Q*T attention term."""
         c = self.config
         # 6N counts matmul'd params only: the embedding lookup is a gather,
-        # not a matmul (the tied/untied lm_head projection IS a matmul and is
-        # a distinct parameter here, so only embed_tokens is excluded).
+        # not a matmul. With tied embeddings the same weight IS matmul'd as
+        # the output projection, so it stays in the count.
         n_params = sum(int(np.prod(p.shape))
                        for name, p in self.named_parameters()
-                       if "embed_tokens" not in name)
+                       if c.tie_word_embeddings or "embed_tokens" not in name)
         attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
         return 6 * n_params + attn
 

@@ -221,3 +221,81 @@ def test_fused_linear_activation():
         activation="relu")
     ref = np.maximum(x @ w + b, 0)
     np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5, rtol=1e-4)
+
+
+def test_rope_position_ids_and_interleaved():
+    from paddle_tpu import incubate
+
+    b, s, h, d = 2, 16, 2, 8
+    q = _rand(b, s, h, d, seed=30)
+    pid = np.stack([np.arange(s), np.arange(2, s + 2)]).astype(np.int64)
+    t = 32
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    freqs = np.outer(np.arange(t), inv)
+    cos, sin = np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+    oq = incubate.nn.functional.fused_rotary_position_embedding(
+        paddle.Tensor(q), cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
+        position_ids=paddle.Tensor(pid))
+    # manual neox rotation with gathered positions
+    c = cos[pid][:, :, None, :]
+    si = sin[pid][:, :, None, :]
+    x1, x2 = q[..., : d // 2], q[..., d // 2:]
+    ref = np.concatenate([x1 * c - x2 * si, x2 * c + x1 * si], -1)
+    np.testing.assert_allclose(np.asarray(oq._data), ref, atol=1e-5, rtol=1e-4)
+
+    # interleaved (GPT-J) style
+    oqi = incubate.nn.functional.fused_rotary_position_embedding(
+        paddle.Tensor(q), cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
+        use_neox_rotary_style=False)
+    ci = cos[:s][None, :, None, :]
+    sii = sin[:s][None, :, None, :]
+    e, o = q[..., 0::2], q[..., 1::2]
+    ref_i = np.stack([e * ci - o * sii, o * ci + e * sii], -1).reshape(q.shape)
+    np.testing.assert_allclose(np.asarray(oqi._data), ref_i, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_rope_rotates_v_when_passed():
+    from paddle_tpu import incubate
+
+    b, s, h, d = 1, 128, 2, 8
+    q, k, v = (_rand(b, s, h, d, seed=s_) for s_ in (31, 32, 33))
+    oq, ok, ov = incubate.nn.functional.fused_rotary_position_embedding(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v))
+    assert not np.allclose(np.asarray(ov._data), v)  # v is rotated too
+
+
+def test_fused_rms_norm_begin_norm_axis():
+    from paddle_tpu import incubate
+
+    x = _rand(2, 3, 4, 5, seed=34)
+    w = np.ones((4, 5), np.float32)
+    out = incubate.nn.functional.fused_rms_norm(
+        paddle.Tensor(x), paddle.Tensor(w), begin_norm_axis=2)
+    flat = x.reshape(2, 3, 20)
+    inv = 1.0 / np.sqrt((flat ** 2).mean(-1, keepdims=True) + 1e-6)
+    ref = (flat * inv).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_varlen_mea_decode_alignment():
+    from paddle_tpu import incubate
+
+    # decode: q len 1 vs kv len 8 -- must attend to ALL cached positions
+    q = _rand(1, 2, 1, 8, seed=35)
+    k = _rand(1, 2, 8, 8, seed=36)
+    v = _rand(1, 2, 8, 8, seed=37)
+    out = incubate.nn.functional.variable_length_memory_efficient_attention(
+        paddle.Tensor(q), paddle.Tensor(k), paddle.Tensor(v),
+        paddle.Tensor(np.array([1])), paddle.Tensor(np.array([8])),
+        causal=True)
+    # reference: full attention over the 8 cached positions
+    scale = 1.0 / np.sqrt(8)
+    s = np.einsum("bhsd,bhtd->bhst", q, k) * scale
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bhtd->bhsd", p, v)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5,
+                               rtol=1e-4)
