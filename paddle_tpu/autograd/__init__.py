@@ -1,0 +1,36 @@
+"""paddle_tpu.autograd — public autograd utilities
+(reference `python/paddle/autograd/`)."""
+from ..core.autograd import (enable_grad, grad, is_grad_enabled,  # noqa: F401
+                             no_grad, run_backward, set_grad_enabled)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward"""
+    return run_backward(tensors, grad_tensors, retain_graph)
+
+
+class saved_tensors_hooks:
+    """Context manager registering pack/unpack hooks for saved activations
+    (reference `python/paddle/autograd/saved_tensors_hooks.py`). The eager
+    tape stores XLA vjp residuals rather than user-visible tensors, so the
+    hooks apply to PyLayer-saved tensors only."""
+
+    _active = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False
+
+
+__all__ = ["PyLayer", "PyLayerContext", "no_grad", "enable_grad",
+           "is_grad_enabled", "set_grad_enabled", "grad", "backward",
+           "saved_tensors_hooks"]

@@ -1,0 +1,130 @@
+"""User-defined autograd functions (PyLayer).
+
+Analog of the reference `python/paddle/autograd/py_layer.py` + C++ side
+`fluid/eager/pylayer/`: a static forward/backward pair whose backward is
+spliced into the eager tape as one graph node.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class _PyLayerGradNode(autograd.GradNodeBase):
+    __slots__ = ("backward_fn", "ctx", "n_tensor_inputs")
+
+    def __init__(self, name, n_outputs, backward_fn, ctx, n_tensor_inputs):
+        super().__init__(name, n_outputs)
+        self.backward_fn = backward_fn
+        self.ctx = ctx
+        self.n_tensor_inputs = n_tensor_inputs
+
+    def run(self, cotangents):
+        import jax.numpy as jnp
+
+        cts = []
+        for i, ct in enumerate(cotangents):
+            if ct is None and self.ctx.materialize_grads:
+                shape, dt = self.out_avals[i]
+                ct = jnp.zeros(shape, dt)
+            cts.append(Tensor(ct, stop_gradient=True) if ct is not None
+                       else None)
+        with autograd.no_grad():
+            grads = self.backward_fn(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        out: List[Optional[object]] = []
+        for g in grads:
+            out.append(g._data if isinstance(g, Tensor) else
+                       (None if g is None else np.asarray(g)))
+        if len(out) != self.n_tensor_inputs:
+            raise RuntimeError(
+                f"PyLayer.backward returned {len(out)} gradients for "
+                f"{self.n_tensor_inputs} tensor inputs")
+        return out
+
+    def release(self):
+        self.ctx._saved = []
+
+
+class PyLayer:
+    """Subclass with static `forward(ctx, *args)` / `backward(ctx, *grads)`
+    and call `apply` (reference `paddle.autograd.PyLayer`)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_slots = [a for a in args if isinstance(a, Tensor)]
+        with autograd.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        wire_outputs(ctx, cls.backward, cls.__name__, tensor_slots, outputs)
+        return outputs
+
+
+def wire_outputs(ctx, backward_fn, name, tensor_slots, outputs):
+    """Splice a PyLayer-style backward into the tape: one node whose edges
+    are the tensor inputs and whose outputs are the Tensor outputs. Shared by
+    PyLayer.apply and recompute."""
+    requires = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_slots)
+    outs = [outputs] if not isinstance(outputs, (tuple, list)) \
+        else list(outputs)
+    out_tensors = [o for o in outs if isinstance(o, Tensor)]
+    if not (requires and out_tensors):
+        return None
+    node = _PyLayerGradNode(name, len(out_tensors), backward_fn, ctx,
+                            len(tensor_slots))
+    for t in tensor_slots:
+        if not t.stop_gradient:
+            if t._grad_node is not None:
+                node.edges.append((t._grad_node, t._out_index))
+            else:
+                node.edges.append((t._ensure_accum_node(), 0))
+        else:
+            node.edges.append(None)
+    for i, o in enumerate(out_tensors):
+        o._stop_gradient = False
+        o._grad_node = node
+        o._out_index = i
+        node.out_avals.append((tuple(o.shape), np.dtype(o._data.dtype)))
+        node.out_hooks.append(o._hooks)
+    return node
+
+
+# legacy alias (paddle.autograd.PyLayerContext is also exported)
+LegacyPyLayer = PyLayer

@@ -6,6 +6,8 @@ arrays, eager collectives are jitted XLA programs over ICI/DCN, rendezvous is
 the JAX coordination service.
 """
 from . import auto_parallel  # noqa: F401
+from . import fleet, sharding  # noqa: F401
+from .fleet.layers.mpu.mp_ops import split  # noqa: F401
 from .auto_parallel import (ShardingStage1, ShardingStage2,  # noqa: F401
                             ShardingStage3, dtensor_from_local,
                             dtensor_to_local, reshard, shard_dataloader,

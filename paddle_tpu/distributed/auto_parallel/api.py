@@ -150,8 +150,7 @@ def reshard(dist_tensor: Tensor, mesh: Optional[ProcessMesh] = None,
         return out
 
     sharding = sb.named_sharding(mesh, placements, np.ndim(arr))
-    if not sg and (dist_tensor._grad_node is not None
-                   or not dist_tensor.stop_gradient):
+    if not sg:
         carrier = dist_tensor if arr is dist_tensor._data else Tensor(arr)
         if arr is not dist_tensor._data:
             carrier.stop_gradient = True  # partial reduce broke the tape
@@ -370,13 +369,32 @@ class ShardDataloader:
     def __len__(self):
         return len(self._loader)
 
-    def _shard_item(self, item, dim):
+    def _dim_for(self, key=None, index=None):
+        """Resolve the reference's polymorphic shard_dims: int | str mesh-dim
+        name | list per-position | dict per-input-key."""
+        sd = self._shard_dims
+        if isinstance(sd, dict):
+            sd = sd.get(key, 0)
+        elif isinstance(sd, (list, tuple)):
+            sd = sd[index] if index is not None and index < len(sd) else 0
+        if isinstance(sd, str):  # a mesh axis name means "shard dim 0 on it"
+            return 0, sd
+        return sd, None
+
+    def _shard_item(self, item, key=None, index=None):
         if isinstance(item, Tensor):
+            if self._input_keys and key is not None and \
+                    key not in self._input_keys:
+                return item
+            dim, axis_name = self._dim_for(key, index)
             placements: List[Placement] = [Replicate()] * self._mesh.ndim
             if dim is not None:
-                axis = 0 if self._mesh.ndim == 1 else (
-                    self._mesh.dim_names.index("dp")
-                    if "dp" in self._mesh.dim_names else 0)
+                if axis_name is not None and axis_name in self._mesh.dim_names:
+                    axis = self._mesh.dim_names.index(axis_name)
+                else:
+                    axis = 0 if self._mesh.ndim == 1 else (
+                        self._mesh.dim_names.index("dp")
+                        if "dp" in self._mesh.dim_names else 0)
                 placements[axis] = Shard(dim)
             return shard_tensor(item, self._mesh, placements)
         return item
@@ -384,13 +402,13 @@ class ShardDataloader:
     def __iter__(self):
         for batch in self._loader:
             if isinstance(batch, dict):
-                yield {k: self._shard_item(v, self._shard_dims)
+                yield {k: self._shard_item(v, key=k)
                        for k, v in batch.items()}
             elif isinstance(batch, (list, tuple)):
-                yield type(batch)(self._shard_item(v, self._shard_dims)
-                                  for v in batch)
+                yield type(batch)(self._shard_item(v, index=i)
+                                  for i, v in enumerate(batch))
             else:
-                yield self._shard_item(batch, self._shard_dims)
+                yield self._shard_item(batch)
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=0,

@@ -143,9 +143,11 @@ def infer_meta_from_array(arr) -> "DistMeta | None":
     if not isinstance(sh, NamedSharding):
         return None
     jm = sh.mesh
-    mesh = ProcessMesh(
-        np.arange(int(np.prod(jm.devices.shape))).reshape(jm.devices.shape),
-        list(jm.axis_names))
+    if hasattr(jm, "devices"):
+        ids = np.vectorize(lambda d: d.id)(jm.devices)
+    else:  # AbstractMesh (inside jit): device ids unknown, use range
+        ids = np.arange(int(np.prod(jm.axis_sizes))).reshape(jm.axis_sizes)
+    mesh = ProcessMesh(ids, list(jm.axis_names))
     # map spec entries back to placements
     placements: List[Placement] = [Replicate() for _ in range(mesh.ndim)]
     spec = sh.spec

@@ -293,27 +293,28 @@ _mailbox = {}
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point send. Single-controller: the value is posted to an
-    in-process mailbox keyed (src_rank, dst_rank); `recv` collects it.
-    In-graph p2p (pipeline stages) uses `ppermute` via `p2p_shift`."""
+    """Point-to-point send. Single-controller: the one process plays every
+    rank, so values queue per (sender, group) and `recv(src=...)` pops them
+    FIFO regardless of the declared dst. In-graph p2p (pipeline stages) uses
+    `ppermute` via `p2p_shift`."""
+    import collections
     import jax
 
     src = jax.process_index()
-    _mailbox[(src, dst, _group(group).id)] = tensor._data
+    key = (src, _group(group).id)
+    _mailbox.setdefault(key, collections.deque()).append(tensor._data)
     return _FinishedTask(tensor)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    import jax
-
-    me = jax.process_index()
-    key = (src, me, _group(group).id)
-    if key not in _mailbox:
+    key = (src, _group(group).id)
+    queue = _mailbox.get(key)
+    if not queue:
         raise RuntimeError(
             f"recv(src={src}): no matching send posted (group "
             f"{_group(group).id}). In single-controller mode send() must "
             f"run before the matching recv().")
-    tensor._data = _mailbox.pop(key)
+    tensor._data = queue.popleft()
     return _FinishedTask(tensor)
 
 
@@ -341,11 +342,16 @@ def p2p_shift(tensor: Tensor, offset: int = 1, group=None) -> Tensor:
 
 
 def barrier(group=None):
+    """Block until all outstanding device work is done (the reference's
+    barrier collective over the group)."""
     import jax
+    import jax.numpy as jnp
 
     jax.effects_barrier()
-    for d in jax.devices():
-        pass
+    g = _group(group)
+    jax.block_until_ready(
+        jax.device_put(jnp.zeros(g.nranks),
+                       _group_sharding(g, 0)))
     return _FinishedTask(None)
 
 

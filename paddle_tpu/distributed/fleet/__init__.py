@@ -1,0 +1,25 @@
+"""paddle_tpu.distributed.fleet (reference `python/paddle/distributed/fleet/`)."""
+from . import meta_parallel, recompute, utils  # noqa: F401
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (CommunicateTopology,  # noqa: F401
+                            HybridCommunicateGroup,
+                            get_hybrid_communicate_group)
+from .fleet import (DygraphShardingOptimizer,  # noqa: F401
+                    HybridParallelOptimizer, distributed_model,
+                    distributed_optimizer, fleet, init)
+from .layers import mpu  # noqa: F401
+
+# facade methods exposed at module level (reference does the same)
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+barrier_worker = fleet.barrier_worker
+
+__all__ = ["init", "fleet", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "HybridCommunicateGroup",
+           "CommunicateTopology", "get_hybrid_communicate_group",
+           "HybridParallelOptimizer", "DygraphShardingOptimizer",
+           "meta_parallel", "utils", "recompute", "mpu"]

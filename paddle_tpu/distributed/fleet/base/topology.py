@@ -1,0 +1,215 @@
+"""Hybrid communicate topology.
+
+Analog of `python/paddle/distributed/fleet/base/topology.py`
+(`CommunicateTopology`, `HybridCommunicateGroup:189-305`): the 5-D cartesian
+process topology **dp × pp × sharding × sep × mp** with per-axis groups.
+
+TPU-native addition: `get_hybrid_mesh()` exposes the same topology as one
+`ProcessMesh` whose axes are the parallelism dims — the object every GSPMD
+placement in fleet layers refers to (SURVEY.md §2.6 TPU note).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...communication.group import Group, new_group
+from ...process_mesh import ProcessMesh
+
+_hcg: Optional["HybridCommunicateGroup"] = None
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = itertools.product(*(range(d) for d in dims))
+        self._world = np.arange(int(np.prod(dims))).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs) -> int:
+        coord = [kwargs[name] for name in self._parallel_names]
+        return int(self._world[tuple(coord)])
+
+    def get_coord(self, rank: int):
+        return tuple(int(x) for x in
+                     np.argwhere(self._world == rank)[0])
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        taken = np.take(self._world, index, axis=axis)
+        return [int(x) for x in taken.flatten()]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along `axis_name`: one per combination of the other
+        coords."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1,
+                                                             self._dims[axis])]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        import jax
+
+        self.global_rank = jax.process_index() if jax.process_count() > 1 \
+            else 0
+        self.nranks = topology.world_size()
+        names = topology.get_hybrid_group_names()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._mp_degree = topology.get_dim("model")
+        self._coord = topology.get_coord(self.global_rank)
+        self._groups: Dict[str, Group] = {}
+        for name in names:
+            self._groups[name] = self._make_group(name)
+        # fused dp×sep group (grad sync for sep params,
+        # reference hybrid_parallel_util.py:254-269)
+        self._dp_sep_group = self._make_fused_group(["data", "sep"])
+
+    # -- group construction -------------------------------------------------
+    def _make_group(self, axis_name) -> Group:
+        for ranks in self._topo.get_comm_list(axis_name):
+            if self.global_rank in ranks:
+                return new_group(ranks)
+        return new_group([self.global_rank])
+
+    def _make_fused_group(self, axis_names) -> Group:
+        names = self._topo.get_hybrid_group_names()
+        fixed = {n: self._coord[i] for i, n in enumerate(names)
+                 if n not in axis_names}
+        ranks = []
+        for rank in range(self.nranks):
+            coord = self._topo.get_coord(rank)
+            if all(coord[names.index(n)] == v for n, v in fixed.items()):
+                ranks.append(rank)
+        return new_group(ranks)
+
+    # -- reference-parity accessors -----------------------------------------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._coord[0]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["data"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._coord[-1]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["model"].ranks[0]
+
+    # pipeline
+    def get_stage_id(self):
+        return self._coord[1]
+
+    def get_pipe_parallel_rank(self):
+        return self._coord[1]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._coord[2]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._groups["sharding"].ranks[0]
+
+    # sep (segment parallel, long-context axis)
+    def get_sep_parallel_rank(self):
+        names = self._topo.get_hybrid_group_names()
+        return self._coord[names.index("sep")] if "sep" in names else 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups.get("sep")
+
+    def get_dp_sep_parallel_group(self):
+        return self._dp_sep_group
+
+    # -- the TPU-native view -------------------------------------------------
+    def get_hybrid_mesh(self) -> ProcessMesh:
+        """The whole topology as one ProcessMesh with axes
+        (dp, pp, sharding, sep, mp) — what fleet layers place params on."""
+        names = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                 "sep": "sep", "model": "mp"}
+        dims = [self._dp_degree, self._pp_degree, self._sharding_degree,
+                self._sep_degree, self._mp_degree]
+        axis_names = [names[n] for n in self._topo.get_hybrid_group_names()]
+        return ProcessMesh(np.arange(self.nranks).reshape(dims), axis_names)
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,160 @@
+"""The fleet facade.
+
+Analog of `python/paddle/distributed/fleet/fleet.py` (`Fleet:151`, `init:218`)
+and `fleet/model.py:32` (`distributed_model`) + the dygraph optimizer
+wrappers (`fleet/meta_optimizers/dygraph_optimizer/`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.tensor import Tensor
+from ..parallel import DataParallel, get_rank, get_world_size, init_parallel_env
+from ..process_mesh import set_mesh
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            get_hybrid_communicate_group,
+                            set_hybrid_communicate_group)
+
+__all__ = ["Fleet", "fleet", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridParallelOptimizer", "DygraphShardingOptimizer"]
+
+
+class HybridParallelOptimizer:
+    """reference `dygraph_optimizer/hybrid_parallel_optimizer.py:258` — the
+    TP-aware wrapper. Grad sync and TP-aware global-norm clipping
+    (`HybridParallelClipGrad:41`) come out of GSPMD: gradients of replicated
+    params leave the XLA program already reduced, and the clip's norm is
+    computed on global (dist) arrays, so the vanilla clip is already
+    TP-correct."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+
+class DygraphShardingOptimizer:
+    """reference `dygraph_optimizer/dygraph_sharding_optimizer.py:48` (stage1;
+    V2=stage2 `:575`): optimizer states sharded over the sharding axis."""
+
+    def __new__(cls, optimizer, hcg=None):
+        from ..auto_parallel.api import ShardingStage1, shard_optimizer
+
+        hcg = hcg or get_hybrid_communicate_group()
+        mesh = hcg.get_hybrid_mesh() if hcg else None
+        return shard_optimizer(optimizer,
+                               ShardingStage1(sharding_mesh_dim="sharding"),
+                               mesh=mesh)
+
+
+class Fleet:
+    """reference `fleet.py:151`"""
+
+    def __init__(self):
+        self._is_initialized = False
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+
+    # -- init ----------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        import jax
+
+        ndev = jax.device_count()
+        degrees = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                   hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                   hc.get("mp_degree", 1)]
+        specified = int(__import__("numpy").prod(degrees))
+        if specified < ndev and ndev % specified == 0:
+            degrees[0] *= ndev // specified  # absorb remainder into dp
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"), degrees)
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        set_mesh(self._hcg.get_hybrid_mesh())
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        import os
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return 0
+
+    def barrier_worker(self):
+        from ..communication.collective import barrier
+
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    # -- model/optimizer wrapping -------------------------------------------
+    def distributed_model(self, model):
+        """reference `fleet/model.py:32,134-174`"""
+        if self._hcg is None:
+            self.init()
+        mode = self._hcg.get_parallel_mode()
+        from .meta_parallel import (PipelineParallel, SegmentParallel,
+                                    TensorParallel)
+
+        if mode == "pipeline":
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if mode == "model":
+            return TensorParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_sep_parallel_world_size() > 1:
+            return SegmentParallel(model, self._hcg, self._strategy)
+        mesh = self._hcg.get_hybrid_mesh()
+        return DataParallel(model, mesh=mesh)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference `fleet.py distributed_optimizer` →
+        `HybridParallelOptimizer` (+ sharding wrapper when sharding_degree>1)."""
+        if self._hcg is None:
+            self.init()
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            optimizer = DygraphShardingOptimizer(optimizer, self._hcg)
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+
+fleet = Fleet()
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer

@@ -1,0 +1,5 @@
+from . import mp_layers, mp_ops, random  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .mp_ops import _c_concat, _c_identity, _c_split, _mp_allreduce, split  # noqa: F401
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401

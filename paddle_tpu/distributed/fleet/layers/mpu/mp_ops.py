@@ -1,0 +1,87 @@
+"""Model-parallel communication primitives.
+
+Analog of `python/paddle/distributed/fleet/layers/mpu/mp_ops.py`
+(`_c_identity:91`, `_c_split:196`, `_mp_allreduce:293`, api `split:706`).
+On TPU these are placement conversions on the hybrid mesh — GSPMD emits the
+actual collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .....core.tensor import Tensor
+from ....auto_parallel.api import reshard
+from ....placement import Partial, Replicate, Shard
+from ...base.topology import get_hybrid_communicate_group
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce", "split"]
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_hybrid_mesh() if hcg else None
+
+
+def _mp_axis(mesh):
+    return mesh.dim_names.index("mp")
+
+
+def _c_identity(tensor: Tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity / backward all-reduce over mp. With GSPMD the
+    backward allreduce is inserted automatically; eagerly this is a no-op."""
+    return tensor
+
+
+def _c_concat(tensor: Tensor, group=None):
+    """Gather mp shards along the last dim (reference `_c_concat`)."""
+    mesh = _mesh()
+    if mesh is None:
+        return tensor
+    return reshard(tensor, mesh, [Replicate()] * mesh.ndim)
+
+
+def _c_split(tensor: Tensor, group=None):
+    """Split the last dim over mp ranks (reference `_c_split`)."""
+    mesh = _mesh()
+    if mesh is None:
+        return tensor
+    placements = [Replicate()] * mesh.ndim
+    placements[_mp_axis(mesh)] = Shard(tensor.ndim - 1)
+    return reshard(tensor, mesh, placements)
+
+
+def _mp_allreduce(tensor: Tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """All-reduce partial results over mp (reference `_mp_allreduce`)."""
+    mesh = _mesh()
+    if mesh is None:
+        return tensor
+    meta = getattr(tensor, "_dist_meta", None)
+    if meta is not None and meta.partial_dims:
+        return reshard(tensor, mesh, [Replicate()] * mesh.ndim)
+    return tensor  # GSPMD already reduced it inside the op
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (reference `mp_ops.py:706`): builds the
+    matching parallel layer and applies it."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation}")

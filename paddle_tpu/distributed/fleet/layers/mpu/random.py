@@ -1,0 +1,77 @@
+"""Tensor-parallel RNG state tracking.
+
+Analog of `python/paddle/distributed/fleet/layers/mpu/random.py`
+(`RNGStatesTracker:34`): named RNG streams so dropout inside TP regions uses
+a per-mp-rank seed while the global stream stays synchronized.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+from .....framework import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, object] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        orig = random_mod.get_rng_state()
+        random_mod.seed(seed)
+        self.states_[name] = random_mod.get_rng_state()
+        random_mod.set_rng_state(orig)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = random_mod.get_rng_state()
+        random_mod.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = random_mod.get_rng_state()
+            random_mod.set_rng_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """Seed the global + model-parallel streams (reference
+    `model_parallel_random_seed`)."""
+    from ...base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    _tracker.reset()
+    random_mod.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)

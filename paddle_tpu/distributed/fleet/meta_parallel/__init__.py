@@ -1,0 +1,9 @@
+"""Model wrappers per parallel mode (reference
+`python/paddle/distributed/fleet/meta_parallel/`)."""
+from .model_wrappers import (PipelineParallel, SegmentParallel,  # noqa: F401
+                             TensorParallel)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .sharding import group_sharded  # noqa: F401
+
+__all__ = ["TensorParallel", "SegmentParallel", "PipelineParallel",
+           "PipelineLayer", "LayerDesc", "SharedLayerDesc"]

@@ -1,0 +1,64 @@
+"""TensorParallel / SegmentParallel model wrappers.
+
+Analog of `fleet/meta_parallel/tensor_parallel.py` and
+`segment_parallel.py:26`. The reference wrappers broadcast parameters and
+register grad-sync hooks; with GSPMD placements both jobs reduce to
+committing every parameter onto the hybrid mesh (replicated unless a parallel
+layer already sharded it) — XLA then inserts the grad all-reduces over the
+right axes (the reference's `fused_allreduce_gradients` over dp×sep,
+`hybrid_parallel_util.py:254-269`).
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...auto_parallel.api import is_dist_tensor, shard_tensor
+from ...placement import Replicate
+from ..base.topology import get_hybrid_communicate_group
+
+
+class _MetaParallelBase:
+    def __init__(self, layers, hcg=None, strategy=None):
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        if self._hcg is None:
+            return
+        mesh = self._hcg.get_hybrid_mesh()
+        for p in self._layers.parameters():
+            if not is_dist_tensor(p):
+                st = shard_tensor(Tensor(p._data), mesh,
+                                  [Replicate()] * mesh.ndim,
+                                  stop_gradient=False)
+                p._data = st._data
+                p._dist_meta = st._dist_meta
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """reference `fleet/meta_parallel/tensor_parallel.py`"""
+
+
+class SegmentParallel(_MetaParallelBase):
+    """reference `fleet/meta_parallel/segment_parallel.py:26` — the sep-axis
+    wrapper for long-context training; inputs sharded on the sequence dim
+    ride the `sep` mesh axis."""
+
+
+# PipelineParallel lives in pipeline_parallel.py (micro-batch schedulers)
+from .pipeline_parallel import PipelineParallel  # noqa: E402,F401

@@ -1,0 +1,200 @@
+"""Pipeline-parallel micro-batch schedulers.
+
+Analog of `fleet/meta_parallel/pipeline_parallel.py` (`PipelineParallel:245`
+1F1B, `PipelineParallelWithInterleave:1161` VPP, `...FthenB:2018`) and the
+static zero-bubble schedules
+(`distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py`).
+
+Two faces, one API:
+
+1. **Eager scheduler** (`train_batch`): splits the batch into micro-batches
+   and walks them in the schedule's order (FThenB stores all micro
+   activations; 1F1B frees each after its backward — the memory profile that
+   defines the schedule). Stage-to-stage tensors cross via the autograd
+   graph; on hardware each stage's params live on its `pp` mesh coordinate so
+   boundary activations traverse ICI exactly like the reference's p2p
+   send/recv with shape handshake (`pp_utils/p2p_communication.py:51`).
+
+2. **Compiled path** (`scan_pipeline`): the TPU-native form — all stages run
+   as ONE jitted program, micro-batches flow through a `lax.scan` whose
+   carry `ppermute`s stage outputs around the `pp` mesh axis (SURVEY.md §7.3
+   hard-part 2). Used by `to_static`/Engine; zero-bubble variants become
+   scan-schedule layouts instead of hand-written interceptor graphs
+   (`fleet_executor/carrier.h:50` has no role on TPU).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ....core.tensor import Tensor
+from ..base.topology import get_hybrid_communicate_group
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel", "scan_pipeline"]
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg=None, strategy=None):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel needs a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", None) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self.schedule = cfg.get("schedule_mode", "1F1B")
+        self.total_loss = None
+
+    # -- plumbing -----------------------------------------------------------
+    def _split_micro(self, data):
+        inputs, labels = data
+        n = self.accumulate_steps
+        bs = inputs.shape[0]
+        if bs % n != 0:
+            raise ValueError(f"batch {bs} not divisible into {n} micro steps")
+        m = bs // n
+        micros = []
+        for i in range(n):
+            sl = slice(i * m, (i + 1) * m)
+            micros.append((Tensor(inputs._data[sl],
+                                  stop_gradient=inputs.stop_gradient),
+                           Tensor(labels._data[sl], stop_gradient=True)))
+        return micros
+
+    def _forward(self, x, label):
+        out = x
+        for stage in range(self._layers.num_stages):
+            out = self._layers.forward_stage(out, stage)
+        loss = self._layers._loss_fn(out, label) if self._layers._loss_fn \
+            else out
+        return loss
+
+    # -- schedules ----------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None):
+        micros = self._split_micro(data)
+        n = len(micros)
+        total = None
+        if self.schedule.upper() in ("FTHENB", "F-THEN-B"):
+            losses = []
+            for x, y in micros:            # all forwards first (peak memory)
+                losses.append(self._forward(x, y))
+            for loss in losses:            # then all backwards
+                scaled = loss * (1.0 / n)
+                if scaler:
+                    scaled = scaler.scale(scaled)
+                scaled.backward()
+                total = loss if total is None else total + loss
+        else:  # 1F1B / VPP / ZBH1: fwd+bwd interleaved, activations freed
+            for x, y in micros:
+                loss = self._forward(x, y)
+                scaled = loss * (1.0 / n)
+                if scaler:
+                    scaled = scaler.scale(scaled)
+                scaled.backward()
+                total = loss if total is None else total + loss
+        self.total_loss = total * (1.0 / n)
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        micros = self._split_micro(data)
+        total = None
+        from ....core.autograd import no_grad
+
+        with no_grad():
+            for x, y in micros:
+                loss = self._forward(x, y)
+                total = loss if total is None else total + loss
+        return total * (1.0 / len(micros))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+
+def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
+                  axis_name: str = "pp"):
+    """Compiled 1F1B-style pipeline as one XLA program (the TPU-native path).
+
+    stage_fn(params, x) -> y: one pipeline stage, identical structure per
+    stage. stage_params: pytree whose leaves are stacked on dim0 over the
+    `pp` mesh axis (stage i's weights live on pp coordinate i).
+    inputs: [n_micro, micro_batch, ...] micro-batch stack.
+
+    Runs inside `shard_map` over the pp axis: each step every stage works on
+    a different micro-batch; the carry `ppermute`s stage outputs to the next
+    stage over ICI. Total steps = n_micro + n_stages - 1 (the classic
+    pipeline trapezoid — bubble fraction (S-1)/(M+S-1)).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_stages = _static_axis_size(axis_name)
+
+    def per_stage(params, xs):
+        # params: this stage's weights (leading stacked dim removed by
+        # shard_map); xs: the micro stack [n_micro, mb, ...] (replicated)
+        stage = jax.lax.axis_index(axis_name)
+        params = jax.tree.map(lambda p: p[0], params)
+
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+
+        def step(carry, t):
+            state, outputs = carry
+            # stage 0 ingests micro-batch t; others take the permuted carry
+            mb_idx = jnp.clip(t, 0, xs.shape[0] - 1)
+            x_in = jnp.where(stage == 0, xs[mb_idx], state)
+            y = stage_fn(params, x_in)
+            # shift stage outputs to the next stage around the pp ring (ICI)
+            nxt = jax.lax.ppermute(
+                y, axis_name,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            # last stage records its result for micro-batch t-(S-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, xs.shape[0] - 1)
+            take = (t >= n_stages - 1) & (stage == n_stages - 1)
+            outputs = jnp.where(take, outputs.at[out_idx].set(y), outputs)
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            step, (state, outputs), jnp.arange(xs.shape[0] + n_stages - 1))
+        # only the last stage wrote anything; psum broadcasts it to all
+        return jax.lax.psum(outputs, axis_name)
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _current_mesh()
+    fn = jax.shard_map(per_stage, mesh=mesh,
+                       in_specs=(P(axis_name), P()), out_specs=P(),
+                       check_vma=False)
+    return fn(stage_params, inputs)
+
+
+def _static_axis_size(axis_name):
+    mesh = _current_mesh()
+    return mesh.shape[axis_name]
+
+
+def _current_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init first")
+    return hcg.get_hybrid_mesh().to_jax_mesh()

@@ -1,0 +1,103 @@
+"""Pipeline layer specification.
+
+Analog of `fleet/meta_parallel/parallel_layers/pp_layers.py`
+(`PipelineLayer:257`, `LayerDesc`, `SharedLayerDesc`): declares a model as an
+ordered layer list partitioned into stages.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ....nn.layer.layers import Layer
+from ..base.topology import get_hybrid_communicate_group
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Stage-partitioned sequential model (reference `pp_layers.py:257`).
+
+    Single-controller note: every stage is materialised (the controller owns
+    all devices); `_start/_end` mark this topology-rank's stage for the
+    schedulers, and the TPU-native compiled path stacks the per-stage params
+    on the `pp` mesh axis.
+    """
+
+    def __init__(self, layers: List, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._stage_id = hcg.get_pipe_parallel_rank() if hcg else 0
+        self._shared = {}
+        built = []
+        for desc in layers:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared:
+                    built.append((self._shared[desc.layer_name],
+                                  desc.forward_func))
+                else:
+                    lyr = desc.build_layer()
+                    self._shared[desc.layer_name] = lyr
+                    built.append((lyr, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            else:
+                built.append((desc, None))
+        self.run_function = []
+        for i, (lyr, fwd) in enumerate(built):
+            if isinstance(lyr, Layer):
+                self.add_sublayer(str(i), lyr)
+            self.run_function.append((lyr, fwd))
+        # uniform segmentation: stage boundaries over the layer list
+        n = len(self.run_function)
+        per = [n // num_stages + (1 if i < n % num_stages else 0)
+               for i in range(num_stages)]
+        self._bounds = [0]
+        for p in per:
+            self._bounds.append(self._bounds[-1] + p)
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_num_virtual_stages(self):
+        return 1
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self._bounds[stage_id], self._bounds[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def forward_stage(self, x, stage_id: int):
+        for lyr, fwd in self.stage_layers(stage_id):
+            x = fwd(lyr, x) if fwd is not None else lyr(x)
+        return x
+
+    def forward(self, x):
+        for stage in range(self._num_stages):
+            x = self.forward_stage(x, stage)
+        return x

@@ -1,0 +1,4 @@
+from . import group_sharded  # noqa: F401
+from .group_sharded import (GroupShardedOptimizerStage2,  # noqa: F401
+                            GroupShardedStage2, GroupShardedStage3,
+                            group_sharded_parallel, save_group_sharded_model)

@@ -1,0 +1,157 @@
+"""Activation recomputation (gradient checkpointing).
+
+Analog of `python/paddle/distributed/fleet/recompute/recompute.py`
+(`recompute:455`, `recompute_sequential:622`) and `recompute_hybrid.py` (TP
+RNG replay). Eager mode: a PyLayer that drops inner activations and replays
+the forward at backward time, restoring the RNG stream so dropout masks
+match. Graph mode (`to_static`/functional_call) should use `jax.checkpoint`
+instead — XLA rematerialisation is the native form of this.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ....autograd.py_layer import PyLayer, PyLayerContext
+from ....core import autograd as core_autograd
+from ....core.tensor import Tensor
+from ....framework import random as random_mod
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx: PyLayerContext, run_function, preserve_rng_state, args,
+                kwargs):
+        ctx.run_function = run_function
+        ctx.kwargs = kwargs
+        ctx.preserve_rng_state = preserve_rng_state
+        if preserve_rng_state:
+            ctx.fw_rng_state = random_mod.get_rng_state()
+            try:
+                from ..layers.mpu.random import get_rng_state_tracker
+
+                ctx.fw_tracker_states = \
+                    get_rng_state_tracker().get_states_tracker()
+            except Exception:
+                ctx.fw_tracker_states = None
+        ctx.inputs = list(args)
+        with core_autograd.no_grad():
+            outputs = run_function(*args, **kwargs)
+        return outputs
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        # replay forward with grad enabled on detached copies
+        detached: List[object] = []
+        tensor_idx = []
+        for i, a in enumerate(ctx.inputs):
+            if isinstance(a, Tensor):
+                d = Tensor(a._data, stop_gradient=a.stop_gradient)
+                detached.append(d)
+                if not a.stop_gradient:
+                    tensor_idx.append(len(detached) - 1)
+            else:
+                detached.append(a)
+        saved_rng = None
+        if ctx.preserve_rng_state:
+            saved_rng = random_mod.get_rng_state()
+            random_mod.set_rng_state(ctx.fw_rng_state)
+            if ctx.fw_tracker_states is not None:
+                from ..layers.mpu.random import get_rng_state_tracker
+
+                saved_tracker = get_rng_state_tracker().get_states_tracker()
+                get_rng_state_tracker().set_states_tracker(
+                    ctx.fw_tracker_states)
+        try:
+            with core_autograd.enable_grad():
+                outputs = ctx.run_function(*detached, **ctx.kwargs)
+        finally:
+            if saved_rng is not None:
+                random_mod.set_rng_state(saved_rng)
+                if ctx.fw_tracker_states is not None:
+                    from ..layers.mpu.random import get_rng_state_tracker
+
+                    get_rng_state_tracker().set_states_tracker(saved_tracker)
+        outs = [outputs] if isinstance(outputs, Tensor) else \
+            [o for o in outputs if isinstance(o, Tensor)]
+        # replay the backward for real: parameter .grads accumulate exactly
+        # as in the un-checkpointed run (reference backward(), recompute.py)
+        core_autograd.run_backward(outs,
+                                   grad_tensors=list(grads)[:len(outs)])
+        result = []
+        for a, d in zip(ctx.inputs, detached):
+            if isinstance(a, Tensor):
+                g = d.grad if isinstance(d, Tensor) else None
+                result.append(g if not a.stop_gradient else None)
+        return tuple(result)
+
+
+def recompute(function, *args, **kwargs):
+    """Checkpoint `function`: store only its inputs, recompute activations in
+    backward (reference `recompute:455`). kwargs: preserve_rng_state=True,
+    use_reentrant=True (both semantics honoured by the single implementation).
+    """
+    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    if not core_autograd.is_grad_enabled() or not any(
+            isinstance(a, Tensor) and not a.stop_gradient for a in args):
+        return function(*args, **kwargs)
+    # PyLayer.apply's edge wiring covers positional Tensor args; run_function
+    # and kwargs ride along as non-tensor state.
+    return _RecomputeApply.apply(function, preserve, args, kwargs)
+
+
+class _RecomputeApply(PyLayer):
+    @staticmethod
+    def forward(ctx, function, preserve, args, kwargs):
+        return _RecomputeFunction.forward(ctx, function, preserve, args,
+                                          kwargs)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        return _RecomputeFunction.backward(ctx, *grads)
+
+    @classmethod
+    def apply(cls, function, preserve, args, kwargs):
+        from ....autograd.py_layer import wire_outputs
+
+        ctx = PyLayerContext()
+        tensor_slots = [a for a in args if isinstance(a, Tensor)]
+        outputs = cls.forward(ctx, function, preserve, args, kwargs)
+        wire_outputs(ctx, cls.backward, "recompute", tensor_slots, outputs)
+        return outputs
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in `segments` chunks (reference
+    `recompute_sequential:622`). ctx: {"segments": n, "preserve_rng_state"}."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    preserve = ctx.get("preserve_rng_state", True) if isinstance(ctx, dict) \
+        else True
+    layers = list(functions)
+    if segments <= 1:
+        return recompute(lambda *a: _run_chain(layers, *a), *args,
+                         preserve_rng_state=preserve, **kwargs)
+    size = max(1, len(layers) // segments)
+    out = args
+    for start in range(0, len(layers), size):
+        chunk = layers[start:start + size]
+        out = (recompute(lambda *a, _c=chunk: _run_chain(_c, *a), *out,
+                         preserve_rng_state=preserve),)
+    return out[0]
+
+
+def _run_chain(layers, *args):
+    out = args
+    for layer in layers:
+        out = layer(*out) if isinstance(out, tuple) else layer(out)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if len(out) == 1 else out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference `recompute_hybrid.py`): same
+    mechanism; the mp RNG tracker state is replayed by `recompute` itself."""
+    return recompute(function, *args, **kwargs)

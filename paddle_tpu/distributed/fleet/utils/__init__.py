@@ -1,0 +1,5 @@
+from .. import recompute as _recompute_pkg  # noqa: F401
+from ..recompute.recompute import recompute  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["recompute", "sequence_parallel_utils"]

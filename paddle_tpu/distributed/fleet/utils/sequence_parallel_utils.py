@@ -1,0 +1,141 @@
+"""Megatron-style sequence parallelism utilities.
+
+Analog of `python/paddle/distributed/fleet/utils/sequence_parallel_utils.py`
+(`ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp:85-147`,
+`ColumnSequenceParallelLinear:427`, `RowSequenceParallelLinear:562`).
+
+TPU-native: the scatter/gather pairs around TP blocks are placement
+conversions of the activation's *sequence* dim over the mp axis; GSPMD emits
+the reduce-scatter/all-gather pair and overlaps it with the adjacent matmuls
+(the role of the reference's `SPInnerOverlapLinear:255`).
+
+Layout note: like the reference, activations are [s, b, h] (seq-major) for
+SP regions; axis 0 is the sequence dim.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...auto_parallel.api import reshard
+from ...placement import Replicate, Shard
+from ..base.topology import get_hybrid_communicate_group
+from ..layers.mpu.mp_layers import ColumnParallelLinear, RowParallelLinear
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "create_fused_allreduce_gradient_hooks",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_hybrid_mesh() if hcg else None
+
+
+def _seq_placements(mesh, seq_axis=0):
+    placements = [Replicate()] * mesh.ndim
+    placements[mesh.dim_names.index("mp")] = Shard(seq_axis)
+    return placements
+
+
+def scatter(input: Tensor, seq_axis: int = 0) -> Tensor:
+    """Split the sequence dim over mp ranks (reference `scatter:55`)."""
+    mesh = _mesh()
+    if mesh is None or "mp" not in mesh.dim_names:
+        return input
+    return reshard(input, mesh, _seq_placements(mesh, seq_axis))
+
+
+def all_gather(input: Tensor, seq_axis: int = 0) -> Tensor:
+    """Gather the sequence dim from mp ranks (reference `all_gather:32`)."""
+    mesh = _mesh()
+    if mesh is None:
+        return input
+    return reshard(input, mesh, [Replicate()] * mesh.ndim)
+
+
+class ScatterOp:
+    """PyLayer-parity callables (fwd scatter / bwd gather happens through the
+    reshard's autograd transpose)."""
+
+    @staticmethod
+    def apply(input, seq_axis=0):
+        return scatter(input, seq_axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(input, seq_axis=0):
+        return all_gather(input, seq_axis)
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(input, seq_axis=0):
+        # partial activations reduce-scatter back onto the sequence dim
+        return scatter(input, seq_axis)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.__dict__["sequence_parallel"] = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return bool(getattr(parameter, "__dict__", {}).get("sequence_parallel"))
+
+
+def create_fused_allreduce_gradient_hooks(parameter_list, accumulation_steps):
+    return []
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """SP-parameter grad sync (reference `:192`): with GSPMD-replicated
+    params the gradient all-reduce is already inside the XLA program, so
+    there is nothing to hook."""
+    return
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear fed by sequence-sharded activations
+    (reference `:427`): all-gather(seq) -> matmul, output sharded on
+    out_features."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        mesh = _mesh()
+        if mesh is not None and self.is_mp:
+            x = all_gather(x)  # sequence -> full before the column matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear producing sequence-sharded output
+    (reference `:562`): matmul -> reduce-scatter onto the seq dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        mesh = _mesh()
+        if mesh is not None and self.is_mp:
+            out = scatter(out)  # reduce-scatter onto the sequence dim
+        return out

@@ -1,0 +1,6 @@
+"""paddle.distributed.sharding parity
+(reference `python/paddle/distributed/sharding/group_sharded.py`)."""
+from ..fleet.meta_parallel.sharding.group_sharded import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

@@ -87,8 +87,11 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, bias=None, residual=None, **kwargs):
     """Fused LayerNorm (+residual), reference
-    `incubate.nn.functional.fused_layer_norm`."""
+    `incubate.nn.functional.fused_layer_norm`. Normalizes over axes
+    [begin_norm_axis:] with the reference's flattened-1-D weight convention.
+    """
     from ....nn import functional as F
+    from ....ops import manipulation
 
     x = as_tensor(x)
     if bias is not None:
@@ -97,8 +100,19 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         x = x + as_tensor(residual)
     residual_out = x if residual is not None else None
     axis = begin_norm_axis if begin_norm_axis >= 0 else begin_norm_axis + x.ndim
-    out = F.layer_norm(x, x.shape[axis:], weight=norm_weight, bias=norm_bias,
+    orig_shape = list(x.shape)
+    flat = x
+    w, b = norm_weight, norm_bias
+    if axis < x.ndim - 1:  # flatten normalized axes (1-D weight convention)
+        flat = manipulation.reshape(x, orig_shape[:axis] + [-1])
+        if w is not None:
+            w = manipulation.reshape(as_tensor(w), [-1])
+        if b is not None:
+            b = manipulation.reshape(as_tensor(b), [-1])
+    out = F.layer_norm(flat, flat.shape[-1:], weight=w, bias=b,
                        epsilon=epsilon)
+    if axis < x.ndim - 1:
+        out = manipulation.reshape(out, orig_shape)
     return (out, residual_out) if residual is not None else out
 
 

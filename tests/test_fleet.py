@@ -1,0 +1,304 @@
+"""Fleet hybrid parallelism tests on the 8-device CPU mesh.
+
+Mirrors the reference's `test/collective/fleet/hybrid_parallel_mp_layers.py`
+etc., single-process over simulated devices.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture
+def hybrid_mp4_dp2():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield fleet.fleet._hcg
+
+
+def test_topology_groups(hybrid_mp4_dp2):
+    hcg = hybrid_mp4_dp2
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 4
+    assert hcg.get_model_parallel_group().nranks == 4
+    assert hcg.get_data_parallel_group().nranks == 2
+    mesh = hcg.get_hybrid_mesh()
+    assert mesh.shape == [2, 1, 1, 1, 4]
+    assert mesh.dim_names == ["dp", "pp", "sharding", "sep", "mp"]
+    topo = hcg.topology()
+    assert topo.get_comm_list("model")[0] == [0, 1, 2, 3]
+    assert topo.get_comm_list("data")[0] == [0, 4]
+
+
+def test_column_row_parallel_linear_numerics(hybrid_mp4_dp2):
+    from paddle_tpu.distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                                         RowParallelLinear)
+
+    paddle.seed(0)
+    col = ColumnParallelLinear(16, 32, gather_output=False, has_bias=True)
+    row = RowParallelLinear(32, 16, input_is_parallel=True, has_bias=True)
+    # weights are sharded over mp
+    wmeta = dist.auto_parallel.placements_of(col.weight)
+    assert any(p == dist.Shard(1) for p in wmeta)
+    rmeta = dist.auto_parallel.placements_of(row.weight)
+    assert any(p == dist.Shard(0) for p in rmeta)
+
+    x = paddle.Tensor(np.random.rand(8, 16).astype(np.float32),
+                      stop_gradient=False)
+    mid = col(x)
+    out = row(mid)
+    assert out.shape == [8, 16]
+    # numerics match the dense computation
+    ref = (np.asarray(x._data) @ np.asarray(col.weight._data)
+           + np.asarray(col.bias._data))
+    ref = ref @ np.asarray(row.weight._data) + np.asarray(row.bias._data)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-4)
+    out.sum().backward()
+    assert col.weight.grad is not None and row.weight.grad is not None
+
+
+def test_vocab_parallel_embedding(hybrid_mp4_dp2):
+    from paddle_tpu.distributed.fleet.layers.mpu import VocabParallelEmbedding
+
+    emb = VocabParallelEmbedding(64, 16)
+    meta = dist.auto_parallel.placements_of(emb.weight)
+    assert any(p == dist.Shard(0) for p in meta)
+    ids = paddle.Tensor(np.array([[1, 5, 63], [0, 2, 33]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 3, 16]
+    ref = np.asarray(emb.weight._data)[np.asarray(ids._data)]
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_parallel_cross_entropy(hybrid_mp4_dp2):
+    from paddle_tpu.distributed.fleet.layers.mpu import ParallelCrossEntropy
+
+    logits = paddle.Tensor(np.random.rand(4, 64).astype(np.float32),
+                           stop_gradient=False)
+    mesh = hybrid_mp4_dp2.get_hybrid_mesh()
+    placements = [dist.Replicate()] * mesh.ndim
+    placements[mesh.dim_names.index("mp")] = dist.Shard(1)  # vocab-sharded
+    ld = dist.shard_tensor(logits, mesh, placements, stop_gradient=False)
+    label = paddle.Tensor(np.random.randint(0, 64, (4,)))
+    loss = ParallelCrossEntropy()(ld, label)
+    assert loss.shape[0] == 4
+    loss.sum().backward()
+
+
+def test_mp_ops(hybrid_mp4_dp2):
+    from paddle_tpu.distributed.fleet.layers.mpu import (_c_concat, _c_split,
+                                                         _c_identity)
+
+    x = paddle.Tensor(np.random.rand(4, 16).astype(np.float32))
+    assert _c_identity(x) is x
+    xs = _c_split(x)
+    assert dist.auto_parallel.placements_of(xs)[-1] == dist.Shard(1)
+    back = _c_concat(xs)
+    np.testing.assert_allclose(np.asarray(back._data), np.asarray(x._data))
+
+
+def test_sequence_parallel_utils(hybrid_mp4_dp2):
+    from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as sp
+
+    x = paddle.Tensor(np.random.rand(8, 2, 16).astype(np.float32))  # [s,b,h]
+    xs = sp.ScatterOp.apply(x)
+    assert dist.auto_parallel.placements_of(xs)[
+        hybrid_mp4_dp2.get_hybrid_mesh().dim_names.index("mp")] == dist.Shard(0)
+    xg = sp.GatherOp.apply(xs)
+    np.testing.assert_allclose(np.asarray(xg._data), np.asarray(x._data))
+
+    lin = sp.ColumnSequenceParallelLinear(16, 32, has_bias=False)
+    out = lin(xs)
+    assert out.shape == [8, 2, 32]
+    rlin = sp.RowSequenceParallelLinear(32, 16, has_bias=False)
+    out2 = rlin(out)
+    assert out2.shape == [8, 2, 16]
+
+
+def test_rng_tracker():
+    from paddle_tpu.distributed.fleet.layers.mpu.random import (
+        RNGStatesTracker)
+
+    tr = RNGStatesTracker()
+    tr.add("stream_a", 1234)
+    paddle.seed(42)
+    r1 = paddle.rand([4])
+    with tr.rng_state("stream_a"):
+        ra = paddle.rand([4])
+    r2 = paddle.rand([4])
+    # global stream unaffected by the tracked stream
+    paddle.seed(42)
+    r1b = paddle.rand([4])
+    r2b = paddle.rand([4])
+    np.testing.assert_array_equal(np.asarray(r1._data), np.asarray(r1b._data))
+    np.testing.assert_array_equal(np.asarray(r2._data), np.asarray(r2b._data))
+    with pytest.raises(ValueError):
+        tr.add("stream_a", 99)
+
+
+def test_fleet_facade_dp_train_step():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu import nn
+
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    dmodel = fleet.distributed_model(model)
+    dopt = fleet.distributed_optimizer(opt)
+    X = np.random.rand(32, 16).astype(np.float32)
+    Y = X.sum(1, keepdims=True).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        out = dmodel(paddle.Tensor(X))
+        loss = ((out - paddle.Tensor(Y)) ** 2).mean()
+        loss.backward()
+        dopt.step()
+        dopt.clear_grad()
+        losses.append(float(loss._data))
+    assert losses[-1] < losses[0] * 0.3
+
+
+def test_fleet_sharding_optimizer():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 8, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu import nn
+
+    model = nn.Linear(16, 16)
+    dmodel = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(parameters=model.parameters()))
+    x = paddle.Tensor(np.random.rand(8, 16).astype(np.float32))
+    loss = dmodel(x).sum()
+    loss.backward()
+    opt.step()
+    accs = opt._inner_opt._inner._accumulators["moment1"]
+    arr = next(iter(accs.values()))
+    assert arr.addressable_shards[0].data.shape[0] == 2  # 16/8 sharded
+
+
+def test_group_sharded_parallel_api():
+    mesh = dist.ProcessMesh(np.arange(8), ["sharding"])
+    dist.set_mesh(mesh)
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    model = nn.Linear(16, 16)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+    # params sharded on dim0 over the sharding axis (hcg's if fleet.init ran)
+    assert any(p == dist.Shard(0)
+               for p in dist.auto_parallel.placements_of(model.weight))
+
+
+# ---------------------------------------------------------------------------
+# PyLayer + recompute
+# ---------------------------------------------------------------------------
+
+def test_py_layer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 3 * x * x
+
+    x = paddle.Tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [12.0, 27.0])
+
+
+def test_recompute_matches_plain_backward():
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    paddle.seed(7)
+    block = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 16))
+    x_np = np.random.rand(4, 16).astype(np.float32)
+
+    x1 = paddle.Tensor(x_np, stop_gradient=False)
+    loss1 = block(x1).sum()
+    loss1.backward()
+    g_plain = np.asarray(x1.grad._data)
+    w_grad_plain = np.asarray(block[0].weight.grad._data)
+    block[0].weight.clear_gradient()
+    block[2].weight.clear_gradient()
+
+    x2 = paddle.Tensor(x_np, stop_gradient=False)
+    loss2 = recompute(block, x2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(np.asarray(x2.grad._data), g_plain, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(block[0].weight.grad._data),
+                               w_grad_plain, rtol=1e-5, atol=1e-5)
+
+
+def test_recompute_preserves_dropout_rng():
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    drop = nn.Dropout(0.5)
+    lin = nn.Linear(32, 32)
+
+    def block(x):
+        return drop(lin(x))
+
+    paddle.seed(123)
+    x = paddle.Tensor(np.random.rand(8, 32).astype(np.float32),
+                      stop_gradient=False)
+    out = recompute(block, x)
+    out.sum().backward()  # would mismatch shapes/masks if RNG not replayed
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._data)).all()
+
+
+def test_send_recv_distinct_ranks():
+    src = paddle.Tensor(np.arange(4, dtype=np.float32))
+    dst = paddle.Tensor(np.zeros(4, np.float32))
+    dist.send(src, dst=3)  # rank 0 -> rank 3
+    dist.recv(dst, src=0)  # "rank 3" collects it
+    np.testing.assert_array_equal(np.asarray(dst._data), np.asarray(src._data))
+
+
+def test_fused_layer_norm_begin_norm_axis():
+    from paddle_tpu import incubate
+
+    x = np.random.rand(2, 3, 4, 5).astype(np.float32)
+    w = np.random.rand(20).astype(np.float32)
+    b = np.random.rand(20).astype(np.float32)
+    out = incubate.nn.functional.fused_layer_norm(
+        paddle.Tensor(x), paddle.Tensor(w), paddle.Tensor(b),
+        begin_norm_axis=2)
+    flat = x.reshape(2, 3, 20)
+    mu = flat.mean(-1, keepdims=True)
+    var = flat.var(-1, keepdims=True)
+    ref = ((flat - mu) / np.sqrt(var + 1e-5) * w + b).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_shard_dataloader_dict_dims():
+    mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+    batches = [{"x": paddle.Tensor(np.zeros((8, 4), np.float32)),
+                "y": paddle.Tensor(np.zeros((8,), np.float32))}]
+    loader = dist.shard_dataloader(batches, mesh, input_keys=["x", "y"],
+                                   shard_dims={"x": 0, "y": 0})
+    batch = next(iter(loader))
+    assert dist.auto_parallel.placements_of(batch["x"])[0] == dist.Shard(0)

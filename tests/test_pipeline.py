@@ -1,0 +1,124 @@
+"""Pipeline parallelism: layer spec, eager micro-batch schedules, and the
+compiled scan+ppermute pipeline (the TPU-native path) on the 8-dev mesh.
+
+Reference analogs: `fleet/meta_parallel/pipeline_parallel.py` (1F1B:245,
+FthenB:2018) and `parallel_layers/pp_layers.py:257`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture
+def pp4():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4,
+                               "sharding_degree": 1, "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def _make_pipe(loss_fn=None):
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+
+    paddle.seed(0)
+    descs = [
+        LayerDesc(nn.Linear, 16, 32),
+        LayerDesc(nn.GELU),
+        LayerDesc(nn.Linear, 32, 32),
+        LayerDesc(nn.GELU),
+        LayerDesc(nn.Linear, 32, 16),
+        LayerDesc(nn.Linear, 16, 1),
+    ]
+    return PipelineLayer(descs, num_stages=4, loss_fn=loss_fn)
+
+
+def test_pipeline_layer_stages(pp4):
+    pipe = _make_pipe()
+    assert pipe.num_stages == 4
+    total = sum(len(pipe.stage_layers(s)) for s in range(4))
+    assert total == 6
+    x = paddle.Tensor(np.random.rand(4, 16).astype(np.float32))
+    out = pipe(x)
+    assert out.shape == [4, 1]
+
+
+@pytest.mark.parametrize("schedule", ["1F1B", "FThenB"])
+def test_pipeline_train_batch_converges(pp4, schedule):
+    pp4.pipeline_configs["schedule_mode"] = schedule
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    pipe = _make_pipe(loss_fn)
+    model = fleet.distributed_model(pipe)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=5e-3,
+                               parameters=pipe.parameters()))
+    X = np.random.rand(8, 16).astype(np.float32)
+    Y = X.sum(1, keepdims=True).astype(np.float32) * 0.1
+    losses = []
+    for _ in range(25):
+        loss = model.train_batch(
+            (paddle.Tensor(X), paddle.Tensor(Y)), opt)
+        losses.append(float(loss._data))
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_pipeline_schedules_agree(pp4):
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    X = np.random.rand(8, 16).astype(np.float32)
+    Y = np.random.rand(8, 1).astype(np.float32)
+
+    grads = {}
+    for schedule in ("1F1B", "FThenB"):
+        pp4.pipeline_configs["schedule_mode"] = schedule
+        pipe = _make_pipe(loss_fn)  # same seed -> same init
+        model = fleet.distributed_model(pipe)
+        loss = model.forward_backward_pipeline(
+            (paddle.Tensor(X), paddle.Tensor(Y)))
+        grads[schedule] = np.asarray(
+            dict(pipe.named_parameters())["0.weight"].grad._data)
+    np.testing.assert_allclose(grads["1F1B"], grads["FThenB"], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_scan_pipeline_compiled(pp4):
+    """The one-jitted-program pipeline: 4 stages on the pp axis, identical
+    per-stage linear; verify against sequential application."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        scan_pipeline)
+
+    n_stages, n_micro, mb, h = 4, 6, 2, 8
+    rng = np.random.default_rng(0)
+    # stage params stacked on dim0 (placed over pp axis by shard_map)
+    Ws = jnp.asarray(rng.standard_normal((n_stages, 1, h, h)) * 0.3,
+                     jnp.float32)
+    xs = jnp.asarray(rng.standard_normal((n_micro, mb, h)), jnp.float32)
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"][0])
+
+    out = scan_pipeline(stage_fn, {"w": Ws}, xs, n_micro, axis_name="pp")
+    # reference: run each micro through all stages sequentially
+    ref = []
+    for m in range(n_micro):
+        x = xs[m]
+        for s in range(n_stages):
+            x = jnp.tanh(x @ Ws[s, 0])
+        ref.append(x)
+    ref = jnp.stack(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
