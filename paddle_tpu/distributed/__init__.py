@@ -7,6 +7,8 @@ the JAX coordination service.
 """
 from . import auto_parallel  # noqa: F401
 from . import fleet, sharding  # noqa: F401
+from . import ring_attention  # noqa: F401
+from .ring_attention import ring_flash_attention, ulysses_attention  # noqa: F401
 from .fleet.layers.mpu.mp_ops import split  # noqa: F401
 from .auto_parallel import (ShardingStage1, ShardingStage2,  # noqa: F401
                             ShardingStage3, dtensor_from_local,

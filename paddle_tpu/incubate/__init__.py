@@ -4,6 +4,6 @@ Analog of `python/paddle/incubate/`: the fused transformer functional surface
 (backed here by the Pallas kernel library instead of hand-CUDA), autograd
 extras, and experimental distributed models.
 """
-from . import nn  # noqa: F401
+from . import distributed, nn  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "distributed"]
