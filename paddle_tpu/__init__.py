@@ -55,7 +55,9 @@ except ImportError:
 
 
 def disable_static(*a, **k):
-    return None
+    from . import static as _s
+
+    return _s.disable_static()
 
 
 def enable_static(*a, **k):

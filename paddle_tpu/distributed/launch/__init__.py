@@ -1,0 +1,2 @@
+from . import main  # noqa: F401
+from .main import launch  # noqa: F401

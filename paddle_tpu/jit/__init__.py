@@ -1,2 +1,6 @@
-"""paddle.jit analog (M4 grows here): functional_call bridge + to_static."""
+"""paddle.jit analog: functional_call bridge, to_static whole-program
+capture, serialized-program save/load."""
 from .functional import buffer_arrays, functional_call, state_arrays  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401
+from .to_static import (InputSpec, StaticFunction, ignore_module,  # noqa: F401
+                        not_to_static, to_static)

@@ -1,0 +1,173 @@
+"""jit.save / jit.load: serialized compiled programs.
+
+Analog of the reference `paddle.jit.save/load` (TranslatedLayer,
+`python/paddle/jit/api.py` + `translated_layer.py`), and of PIR program
+serialization (`paddle/fluid/pir/serialize_deserialize/`): the program
+artifact here is portable StableHLO produced by `jax.export` — the same
+role the reference's `.pdmodel`/json PIR plays, but consumable by any XLA
+runtime. Parameters go to `<path>.pdiparams`.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .functional import buffer_arrays, functional_call, state_arrays
+from .to_static import InputSpec, StaticFunction
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _specs_to_avals(input_spec, example_inputs=None):
+    import jax
+
+    from ..framework import dtype as dtype_mod
+
+    avals = []
+    if input_spec:
+        for spec in input_spec:
+            if isinstance(spec, InputSpec):
+                shape = [1 if (s is None or s < 0) else int(s)
+                         for s in spec.shape]
+                avals.append(jax.ShapeDtypeStruct(
+                    tuple(shape), dtype_mod.to_np(spec.dtype)))
+            elif isinstance(spec, Tensor):
+                avals.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                                  spec._data.dtype))
+    elif example_inputs:
+        for t in example_inputs:
+            avals.append(jax.ShapeDtypeStruct(tuple(t.shape),
+                                              t._data.dtype))
+    else:
+        raise ValueError("jit.save needs input_spec or example inputs")
+    return avals
+
+
+def save(layer, path: str, input_spec=None, **configs):
+    """Serialize a Layer (or to_static'd Layer) to `<path>.pdmodel`
+    (StableHLO) + `<path>.pdiparams` (weights)."""
+    import jax
+
+    if isinstance(layer, StaticFunction):
+        target = layer._layer
+    elif isinstance(layer, Layer):
+        target = layer
+    else:
+        raise TypeError("jit.save expects a Layer or to_static function")
+    was_training = target.training
+    target.eval()
+    try:
+        params = dict(sorted(state_arrays(target).items()))
+        buffers = dict(sorted(buffer_arrays(target).items()))
+
+        def pure_fn(params, buffers, *inputs):
+            out = functional_call(target, params, *inputs, buffers=buffers)
+            flat, struct = _flatten(out)
+            pure_fn._struct = struct
+            return tuple(flat)
+
+        if input_spec is None:
+            input_spec = getattr(layer, "_input_spec", None)
+        avals = _specs_to_avals(input_spec)
+        param_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for k, v in params.items()}
+        buffer_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        for k, v in buffers.items()}
+        exported = jax.export.export(jax.jit(pure_fn))(
+            param_avals, buffer_avals, *avals)
+        blob = exported.serialize()
+        meta = {
+            "stablehlo": bytes(blob),
+            "out_struct": getattr(pure_fn, "_struct", None),
+            "param_names": list(params.keys()),
+            "buffer_names": list(buffers.keys()),
+            "input_avals": [(list(a.shape), str(a.dtype)) for a in avals],
+        }
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(meta, f)
+        from ..framework.io import save as fsave
+
+        fsave({k: Tensor(v) for k, v in {**params, **buffers}.items()},
+              path + ".pdiparams")
+    finally:
+        if was_training:
+            target.train()
+
+
+def _flatten(out):
+    flat = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            flat.append(o._data)
+            return len(flat) - 1
+        if isinstance(o, (list, tuple)):
+            return type(o)(rec(x) for x in o)
+        if isinstance(o, dict):
+            return {k: rec(v) for k, v in o.items()}
+        if o is None:
+            return None
+        flat.append(o)
+        return len(flat) - 1
+
+    struct = rec(out)
+    return flat, struct
+
+
+class TranslatedLayer(Layer):
+    """A loaded serialized program (reference
+    `python/paddle/jit/translated_layer.py`)."""
+
+    def __init__(self, meta, weights):
+        super().__init__()
+        import jax
+
+        self._exported = jax.export.deserialize(
+            bytearray(meta["stablehlo"]))
+        self._meta = meta
+        self._params = {k: weights[k]._data if isinstance(weights[k], Tensor)
+                        else np.asarray(weights[k])
+                        for k in meta["param_names"]}
+        self._buffers_d = {k: weights[k]._data if isinstance(weights[k],
+                                                             Tensor)
+                           else np.asarray(weights[k])
+                           for k in meta["buffer_names"]}
+        for name, arr in self._params.items():
+            p = self.create_parameter(list(arr.shape),
+                                      dtype=str(np.dtype(arr.dtype)))
+            p._data = arr
+            self.add_parameter(name.replace(".", "__"), p)
+            # keep the exported-call copy in sync with the Parameter object
+            self._params[name] = p._data
+
+    def forward(self, *inputs):
+        arrays = [t._data if isinstance(t, Tensor) else t for t in inputs]
+        outs = self._exported.call(self._params, self._buffers_d, *arrays)
+        struct = self._meta.get("out_struct")
+        tensors = [Tensor(o) for o in outs]
+        if struct is None:
+            return tensors[0] if len(tensors) == 1 else tensors
+
+        def rec(s):
+            if isinstance(s, int):
+                return tensors[s]
+            if isinstance(s, (list, tuple)):
+                return type(s)(rec(x) for x in s)
+            if isinstance(s, dict):
+                return {k: rec(v) for k, v in s.items()}
+            return s
+
+        return rec(struct)
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    from ..framework.io import load as fload
+
+    weights = fload(path + ".pdiparams")
+    return TranslatedLayer(meta, weights)

@@ -1,0 +1,224 @@
+"""@to_static: whole-program capture + compilation.
+
+Analog of the reference dy2static stack (`python/paddle/jit/api.py:195`
+`to_static`, `program_translator.py:2178` StaticFunction, SOT bytecode
+frontend `jit/sot/translate.py:31`). TPU-native mechanism (SURVEY.md §7.2
+M4): instead of AST transforms / a CPython eval-frame hook building a PIR
+program, the layer's forward is traced ONCE per input signature through
+`functional_call` into a single jitted XLA program. Parameters enter as tape
+inputs of one dispatch op, so eager autograd records the whole program as one
+node and the backward replays one compiled vjp — per-op dispatch overhead
+collapses to two executable launches per step (the reference's hard part 1,
+SURVEY.md §7.3).
+
+Dynamic python control flow re-specialises per input signature (the
+"guard" role of SOT); data-dependent branches inside the traced code must
+use lax.cond-style ops, like any XLA program.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from .functional import functional_call, state_arrays
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
+           "ignore_module"]
+
+_counter = itertools.count()
+
+
+class InputSpec:
+    """reference `paddle.static.InputSpec`."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(np.dtype(tensor._data.dtype)), name)
+
+
+class StaticFunction:
+    """A callable compiled per input signature
+    (reference `program_translator.py:2178`)."""
+
+    def __init__(self, function: Callable, layer=None, input_spec=None,
+                 build_strategy=None, backend=None, full_graph=True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._id = next(_counter)
+        self._out_structs: Dict[tuple, Any] = {}
+        self._op_registered = False
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    # -- signature key -------------------------------------------------------
+    def _key(self, tensor_args, static_kwargs, training):
+        avals = tuple((tuple(t.shape), str(np.dtype(t._data.dtype)))
+                      for t in tensor_args)
+        return (avals, tuple(sorted(static_kwargs.items())), training)
+
+    def _param_items(self):
+        if self._layer is None:
+            return []
+        return sorted(state_arrays(self._layer).items())
+
+    def _ensure_op(self):
+        if self._op_registered:
+            return
+        self._op_registered = True
+        sf = self
+
+        def op_fn(*arrays, n_params, param_names, static_kwargs, key):
+            from .functional import _swapped
+
+            params = dict(zip(param_names, arrays[:n_params]))
+            inputs = [Tensor(a) for a in arrays[n_params:]]
+            kwargs = dict(static_kwargs)
+            if sf._layer is not None:
+                # call the ORIGINAL forward (sf replaced layer.forward), with
+                # the traced param arrays swapped in
+                with _swapped(sf._layer, params):
+                    out = sf._function(*inputs, **kwargs)
+            else:
+                out = sf._function(*inputs, **kwargs)
+            flat, struct = _flatten_out(out)
+            sf._out_structs[key] = struct
+            return tuple(t._data for t in flat) if len(flat) != 1 \
+                else flat[0]._data
+
+        dispatch.register_op(f"to_static_{self._id}", op_fn, multi_out=True)
+
+    def __call__(self, *args, **kwargs):
+        tensor_args = []
+        arg_template = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_template.append(None)
+                tensor_args.append(a)
+            elif isinstance(a, (np.ndarray, list)) and not isinstance(a, str):
+                t = Tensor(np.asarray(a))
+                arg_template.append(None)
+                tensor_args.append(t)
+            else:
+                arg_template.append(a)
+        if arg_template and any(x is not None for x in arg_template):
+            raise NotImplementedError(
+                "to_static supports non-tensor positional args only as "
+                "keyword arguments")
+        static_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor):
+                raise NotImplementedError(
+                    "pass Tensors positionally to a to_static function")
+            static_kwargs[k] = v
+        training = bool(self._layer.training) if self._layer is not None \
+            else True
+        key = self._key(tensor_args, static_kwargs, training)
+        self._ensure_op()
+        params = self._param_items()
+        param_tensors = []
+        if self._layer is not None:
+            named = dict(self._layer.named_parameters())
+            param_tensors = [named[k] for k, _ in params]
+        attrs = {"n_params": len(params),
+                 "param_names": tuple(k for k, _ in params),
+                 "static_kwargs": tuple(sorted(static_kwargs.items())),
+                 "key": key}
+        outs = dispatch.apply(f"to_static_{self._id}",
+                              list(param_tensors) + tensor_args, attrs)
+        struct = self._out_structs.get(key)
+        return _unflatten_out(outs, struct)
+
+    # -- reference-parity helpers -------------------------------------------
+    @property
+    def forward(self):
+        return self
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        return self._function
+
+
+def _flatten_out(out):
+    """-> (list of Tensors, structure template with int placeholders)."""
+    flat: List[Tensor] = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            flat.append(o)
+            return len(flat) - 1
+        if isinstance(o, (list, tuple)):
+            return type(o)(rec(x) for x in o)
+        if isinstance(o, dict):
+            return {k: rec(v) for k, v in o.items()}
+        return ("__const__", o)
+
+    struct = rec(out)
+    return flat, struct
+
+
+def _unflatten_out(outs, struct):
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+
+    def rec(s):
+        if isinstance(s, int):
+            return outs[s]
+        if isinstance(s, tuple) and len(s) == 2 and s[0] == "__const__":
+            return s[1]
+        if isinstance(s, (list, tuple)):
+            return type(s)(rec(x) for x in s)
+        if isinstance(s, dict):
+            return {k: rec(v) for k, v in s.items()}
+        return s
+
+    return rec(struct)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper compiling a function or Layer
+    (reference `paddle.jit.to_static`, `jit/api.py:195`)."""
+
+    def wrap(fn):
+        from ..nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec,
+                                build_strategy=build_strategy)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, layer=getattr(fn, "__self__", None),
+                              input_spec=input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn=None):
+    """Mark a function to stay eager (reference `paddle.jit.not_to_static`)."""
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None

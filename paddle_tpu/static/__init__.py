@@ -1,0 +1,223 @@
+"""paddle.static compatibility shim.
+
+The reference's static mode builds a PIR program executed by an interpreter
+(SURVEY.md §3.3, L4b-L5). On TPU that whole pipeline IS XLA: a "Program" here
+wraps a traced+compiled callable (built by `paddle.jit.to_static` /
+`jax.export`), the "interpreter" is the PJRT executable, and passes/CINN are
+XLA's own pipeline. This module keeps the `paddle.static` surface —
+Executor.run(feed/fetch), save/load_inference_model, program guards — over
+that design.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.to_static import InputSpec  # noqa: F401
+from ..jit import save_load as _sl
+
+__all__ = ["InputSpec", "Program", "CompiledProgram", "Executor",
+           "default_main_program", "default_startup_program",
+           "program_guard", "data", "enable_static", "disable_static",
+           "in_static_mode", "save_inference_model", "load_inference_model",
+           "name_scope", "py_func", "gradients", "save", "load",
+           "normalize_program"]
+
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
+
+
+class Variable:
+    """Symbolic placeholder (the reference's `paddle.static.data` Variable)."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.stop_gradient = True
+
+    def __repr__(self):
+        return f"var[{self.name}:{self.shape}:{self.dtype}]"
+
+
+class Program:
+    """A build-then-run unit. `fn`-backed: holds a python callable traced per
+    signature (the XLA-native replacement for the op-list program,
+    `pir/include/core/program.h:40`)."""
+
+    def __init__(self, fn=None, feed_names=None, fetch_count=None):
+        self._fn = fn
+        self._feed_names = feed_names or []
+        self._fetch_count = fetch_count
+        self._datas: Dict[str, Variable] = {}
+        self.random_seed = None
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    # block-protocol stubs used by porting code
+    @property
+    def blocks(self):
+        return [self]
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+
+    def __enter__(self):
+        global _default_main
+        self._prev = _default_main
+        _default_main = self._main
+        return self._main
+
+    def __exit__(self, *exc):
+        global _default_main
+        _default_main = self._prev
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0) -> Variable:
+    var = Variable(name, shape, dtype)
+    _default_main._datas[name] = var
+    return var
+
+
+class CompiledProgram(Program):
+    """reference `CompiledProgram` — compilation is jit, so this is Program."""
+
+    def __init__(self, program, build_strategy=None):
+        super().__init__(program._fn, program._feed_names,
+                         program._fetch_count)
+        self._translated = getattr(program, "_translated", None)
+
+
+class Executor:
+    """`paddle.static.Executor` analog (`python/paddle/base/executor.py:1746`
+    Executor.run → StandaloneExecutor): runs a Program's compiled callable on
+    feeds and returns fetched numpy arrays."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        translated = getattr(program, "_translated", None)
+        if translated is not None:
+            inputs = [Tensor(np.asarray(feed[n]))
+                      for n in program._feed_names]
+            outs = translated(*inputs)
+        elif program._fn is not None:
+            names = program._feed_names or list(feed.keys())
+            inputs = [Tensor(np.asarray(feed[n])) for n in names]
+            outs = program._fn(*inputs)
+        else:
+            raise ValueError(
+                "Program has no compiled function; build it with "
+                "paddle.jit.to_static / load_inference_model")
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if return_numpy:
+            return [np.asarray(o._data) if isinstance(o, Tensor) else o
+                    for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize an inference program (reference `static/io.py`): the program
+    must come from a Layer/to_static function carried by `program` or by
+    `fetch_vars` being Tensors produced by one. Preferred path:
+    `paddle.jit.save`."""
+    layer = kwargs.get("layer")
+    if layer is None and program is not None:
+        layer = getattr(program, "_layer", None)
+    if layer is None:
+        raise ValueError("save_inference_model needs layer=<Layer> (the "
+                         "XLA-native program carrier); or use paddle.jit.save")
+    specs = [InputSpec(v.shape, v.dtype, v.name)
+             if isinstance(v, Variable) else InputSpec.from_tensor(v)
+             for v in feed_vars]
+    _sl.save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """-> (program, feed_names, fetch_names) (reference `static/io.py`)."""
+    translated = _sl.load(path_prefix)
+    meta = translated._meta
+    feed_names = [f"x{i}" for i in range(len(meta["input_avals"]))]
+    program = Program(fn=None, feed_names=feed_names)
+    program._translated = translated
+    n_out = None
+    return program, feed_names, [f"out{i}" for i in range(n_out or 1)]
+
+
+def save(program, model_path, protocol=4, **configs):
+    import pickle
+
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump({"feed_names": program._feed_names}, f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    return None
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func: wrap python code with paddle.autograd"
+                              ".PyLayer in the TPU build")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad
+
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program

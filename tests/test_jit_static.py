@@ -1,0 +1,170 @@
+"""to_static, jit.save/load, static.Executor, launch CLI tests.
+
+Reference analogs: `test/dygraph_to_static/`, `test/jit/`,
+`test/standalone_executor/`.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_to_static_layer_matches_eager():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    x = paddle.Tensor(np.random.rand(2, 8).astype(np.float32))
+    eager = model(x)
+    smodel = paddle.jit.to_static(model)
+    static = smodel(x)
+    np.testing.assert_allclose(np.asarray(static._data),
+                               np.asarray(eager._data), rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_trains_params():
+    paddle.seed(1)
+    model = nn.Linear(4, 1)
+    smodel = paddle.jit.to_static(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    X = np.random.rand(16, 4).astype(np.float32)
+    Y = X.sum(1, keepdims=True)
+    first = last = None
+    for _ in range(40):
+        out = smodel(paddle.Tensor(X))
+        loss = ((out - paddle.Tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        last = float(loss._data)
+        if first is None:
+            first = last
+    assert last < first * 0.1, (first, last)
+
+
+def test_to_static_function_and_recompile_per_shape():
+    from paddle_tpu.core.dispatch import cache_stats
+
+    @paddle.jit.to_static
+    def fn(a, b):
+        return paddle.matmul(a, b).sum()
+
+    a = paddle.Tensor(np.random.rand(4, 8).astype(np.float32))
+    b = paddle.Tensor(np.random.rand(8, 2).astype(np.float32))
+    out = fn(a, b)
+    np.testing.assert_allclose(float(out._data),
+                               float((np.asarray(a._data) @
+                                      np.asarray(b._data)).sum()), rtol=1e-5)
+    # second call same shape: no new trace of the registered op (out struct
+    # already recorded)
+    out2 = fn(a, b)
+    assert out2.shape == []
+
+
+def test_to_static_tuple_outputs():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            return h, h.sum()
+
+    m = paddle.jit.to_static(M())
+    h, s = m(paddle.Tensor(np.random.rand(2, 4).astype(np.float32)))
+    assert h.shape == [2, 4] and s.shape == []
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(2)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    x = paddle.Tensor(np.random.rand(2, 8).astype(np.float32))
+    ref = model(x)
+    path = str(tmp_path / "model")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([2, 8], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+    loaded = paddle.jit.load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data),
+                               rtol=1e-5, atol=1e-6)
+    # loaded layer exposes parameters
+    assert len(list(loaded.parameters())) == 4
+
+
+def test_static_executor_over_loaded_program(tmp_path):
+    import paddle_tpu.static as static
+
+    paddle.seed(3)
+    model = nn.Linear(4, 2)
+    model.eval()
+    path = str(tmp_path / "infer")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+    exe = static.Executor()
+    program, feed_names, fetch_names = static.load_inference_model(path, exe)
+    x = np.random.rand(1, 4).astype(np.float32)
+    outs = exe.run(program, feed={feed_names[0]: x})
+    ref = model(paddle.Tensor(x))
+    np.testing.assert_allclose(outs[0], np.asarray(ref._data), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_static_mode_flags():
+    import paddle_tpu.static as static
+
+    assert not static.in_static_mode()
+    paddle.enable_static()
+    assert static.in_static_mode()
+    paddle.disable_static()
+    assert not static.in_static_mode()
+
+
+def test_static_gradients():
+    import paddle_tpu.static as static
+
+    x = paddle.Tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = static.gradients(y, x)
+    np.testing.assert_allclose(np.asarray(g._data), [4.0, 6.0])
+
+
+def test_launch_cli_env_contract(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '2'\n"
+        "assert os.environ['PADDLE_TRAINER_ID'] in ('0', '1')\n"
+        "assert 'PADDLE_TRAINER_ENDPOINTS' in os.environ\n"
+        "print('worker', os.environ['PADDLE_TRAINER_ID'], 'ok')\n")
+    log_dir = str(tmp_path / "logs")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, str(script)],
+        cwd="/root/repo", env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    logs = sorted(os.listdir(log_dir))
+    assert len(logs) == 2
+    content = open(os.path.join(log_dir, logs[0])).read()
+    assert "ok" in content
+
+
+def test_launch_cli_failure_detection(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restart", "1",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        cwd="/root/repo", env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 3
+    assert "restart budget" in res.stderr or "relaunch" in res.stderr
