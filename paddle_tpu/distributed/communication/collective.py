@@ -294,21 +294,18 @@ _mailbox = {}
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     """Point-to-point send. Single-controller: the one process plays every
-    rank, so values queue per (sender, group) and `recv(src=...)` pops them
-    FIFO regardless of the declared dst. In-graph p2p (pipeline stages) uses
-    `ppermute` via `p2p_shift`."""
+    rank, so values queue per group and `recv(src=...)` pops them FIFO
+    regardless of the declared src/dst ranks. In-graph p2p (pipeline stages)
+    uses `ppermute` via `p2p_shift`."""
     import collections
-    import jax
 
-    src = jax.process_index()
-    key = (src, _group(group).id)
+    key = _group(group).id
     _mailbox.setdefault(key, collections.deque()).append(tensor._data)
     return _FinishedTask(tensor)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    key = (src, _group(group).id)
-    queue = _mailbox.get(key)
+    queue = _mailbox.get(_group(group).id)
     if not queue:
         raise RuntimeError(
             f"recv(src={src}): no matching send posted (group "

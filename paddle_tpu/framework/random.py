@@ -12,23 +12,33 @@ import numpy as np
 
 
 class Generator:
-    """Stateful splitting RNG over a jax PRNG key."""
+    """Stateful splitting RNG over a jax PRNG key.
+
+    Key material is created lazily on first use so that importing the package
+    (e.g. from the launch CLI, which must NOT grab the exclusive TPU chip in
+    the launcher process) never initializes a JAX backend.
+    """
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
         self.manual_seed(seed)
 
     def manual_seed(self, seed: int):
-        import jax
-
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(self._seed)
+            self._key = None  # built lazily; jax backend untouched until use
             self._counter = 0
         return self
 
     def initial_seed(self) -> int:
         return self._seed
+
+    def _ensure_key(self):
+        import jax
+
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def next_key(self):
         """Return a fresh PRNG key; advances internal state."""
@@ -39,16 +49,14 @@ class Generator:
             # reproducible given (seed, counter) — mirrors the reference's
             # (seed, offset) random state pair (phi/core/generator.h).
             self._counter += 1
-            return jax.random.fold_in(self._key, self._counter)
+            return jax.random.fold_in(self._ensure_key(), self._counter)
 
     def get_state(self):
         return (self._seed, self._counter)
 
     def set_state(self, state):
-        import jax
-
         self._seed, self._counter = int(state[0]), int(state[1])
-        self._key = jax.random.key(self._seed)
+        self._key = None
 
 
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))

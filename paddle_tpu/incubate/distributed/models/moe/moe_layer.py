@@ -160,6 +160,13 @@ def _dispatch_combine_fn(x, probs, capacity, top_k):
     t, e = probs.shape
     # top-k expert choice per token
     topv, topi = jax.lax.top_k(probs, top_k)          # [T,k]
+    # GShard gate semantics: combine weights are the top-k probs renormalized
+    # over the selected experts (gshard_gate divides the top-2 gates by their
+    # sum) — without this, expert outputs are systematically down-weighted.
+    # top-1 gates (Switch) keep the raw prob: renormalizing would collapse the
+    # weight to 1.0 and cut the router out of the task-loss gradient.
+    if top_k > 1:
+        topv = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
     # position of each token within its expert's queue (per k-slot,
     # sequential over slots so top-1 fills first — GShard's priority order)
     combine = jnp.zeros((t, e, capacity), probs.dtype)

@@ -129,14 +129,18 @@ def _rope_generic_fn(x, cos, sin, neox, batched, offset):
         s = sin[offset:offset + s_len][None, :, None, :].astype(jnp.float32)
     xf = x.astype(jnp.float32)
     if neox:
-        d2 = x.shape[-1] // 2
-        x1, x2 = xf[..., :d2], xf[..., d2:]
-        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
-    else:  # GPT-J interleaved pairs (even, odd)
+        # reference True = "every two adjacent numbers are calculated"
+        # (rotate_every_two in fused_rope_utils.h): pairs (x[2i], x[2i+1]).
         x1, x2 = xf[..., 0::2], xf[..., 1::2]
         r1 = x1 * c - x2 * s
         r2 = x2 * c + x1 * s
         out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    else:
+        # reference False = front-half/back-half segments (rotate_half):
+        # pairs (x[i], x[i + D/2]).
+        d2 = x.shape[-1] // 2
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -152,8 +156,10 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
     q/k/v: [B, S, H, D] — every provided tensor is rotated (reference
     semantics). cos/sin: [T, D/2] half tables or [T, D]/broadcastable full
-    tables (auto-halved). `position_ids` [B, S] gathers per-batch rows;
-    `use_neox_rotary_style=False` rotates interleaved (GPT-J) pairs.
+    tables (auto-halved per layout). `position_ids` [B, S] gathers per-batch
+    rows. `use_neox_rotary_style=True` rotates adjacent interleaved pairs
+    (x[2i], x[2i+1]); `False` rotates front-half/back-half segments
+    (x[i], x[i+D/2]) — the reference convention.
     """
     import jax.numpy as jnp
 
@@ -169,13 +175,20 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         cos = Tensor(jnp.asarray(np.cos(freqs), q._data.dtype))
         sin = Tensor(jnp.asarray(np.sin(freqs), q._data.dtype))
     cos, sin = as_tensor(cos), as_tensor(sin)
-    # accept [*, T, D] full tables: squeeze + halve
+    # accept [*, T, D] full tables: squeeze + halve per rotary layout.
+    # Adjacent-pair (neox=True) full tables duplicate each freq at positions
+    # (2i, 2i+1) -> take the strided [0::2] half; rotate-half (neox=False)
+    # tables duplicate front/back -> take [:D/2].
     if cos.ndim > 2:
         cos = Tensor(cos._data.reshape(-1, cos.shape[-1]))
         sin = Tensor(sin._data.reshape(-1, sin.shape[-1]))
     if cos.shape[-1] == d:
-        cos = Tensor(cos._data[..., : d // 2])
-        sin = Tensor(sin._data[..., : d // 2])
+        if use_neox_rotary_style:
+            cos = Tensor(cos._data[..., 0::2])
+            sin = Tensor(sin._data[..., 0::2])
+        else:
+            cos = Tensor(cos._data[..., : d // 2])
+            sin = Tensor(sin._data[..., : d // 2])
 
     batched = position_ids is not None
     if batched:
@@ -189,7 +202,9 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if v is not None:
         tensors.append(("v", as_tensor(v)))
 
-    use_pallas = (use_neox_rotary_style and not batched and _pallas_on(q)
+    # The Pallas kernel implements the rotate-half (front/back segment)
+    # rotation, i.e. the reference's use_neox_rotary_style=False layout.
+    use_pallas = (not use_neox_rotary_style and not batched and _pallas_on(q)
                   and _prope.supported(tuple(q.shape), q._data.dtype)
                   and k is not None
                   and tuple(q.shape) == tuple(as_tensor(k).shape))
@@ -202,7 +217,7 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         if v is not None:
             outs["v"] = dispatch.apply(
                 "rope_generic", [as_tensor(v), cos, sin],
-                {"neox": True, "batched": False, "offset": int(offset)})
+                {"neox": False, "batched": False, "offset": int(offset)})
     else:
         attrs = {"neox": bool(use_neox_rotary_style), "batched": batched,
                  "offset": int(offset)}

@@ -28,13 +28,27 @@ def _specs_to_avals(input_spec, example_inputs=None):
     from ..framework import dtype as dtype_mod
 
     avals = []
+    scope = None
     if input_spec:
-        for spec in input_spec:
+        for arg_idx, spec in enumerate(input_spec):
             if isinstance(spec, InputSpec):
-                shape = [1 if (s is None or s < 0) else int(s)
-                         for s in spec.shape]
+                # Dynamic dims (None/-1) become jax.export symbolic dims so
+                # the exported program accepts any size there (the reference's
+                # dynamic-dim support). Each (input, dim) gets its own symbol
+                # so independently-declared dynamic dims are not silently
+                # constrained equal.
+                if any(s is None or s < 0 for s in spec.shape):
+                    if scope is None:
+                        scope = jax.export.SymbolicScope()
+                    shape = tuple(
+                        jax.export.symbolic_shape(f"d{arg_idx}_{i}",
+                                                  scope=scope)[0]
+                        if (s is None or s < 0) else int(s)
+                        for i, s in enumerate(spec.shape))
+                else:
+                    shape = tuple(int(s) for s in spec.shape)
                 avals.append(jax.ShapeDtypeStruct(
-                    tuple(shape), dtype_mod.to_np(spec.dtype)))
+                    shape, dtype_mod.to_np(spec.dtype)))
             elif isinstance(spec, Tensor):
                 avals.append(jax.ShapeDtypeStruct(tuple(spec.shape),
                                                   spec._data.dtype))
@@ -85,7 +99,8 @@ def save(layer, path: str, input_spec=None, **configs):
             "out_struct": getattr(pure_fn, "_struct", None),
             "param_names": list(params.keys()),
             "buffer_names": list(buffers.keys()),
-            "input_avals": [(list(a.shape), str(a.dtype)) for a in avals],
+            "input_avals": [([str(d) for d in a.shape], str(a.dtype))
+                            for a in avals],
         }
         with open(path + ".pdmodel", "wb") as f:
             pickle.dump(meta, f)

@@ -184,14 +184,29 @@ class LlamaModel(nn.Layer):
         self.layers = nn.LayerList(
             [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        # When True and running under jax tracing (graph mode), each decoder
+        # layer is wrapped in jax.checkpoint so activations are rematerialised
+        # in backward — the HBM/FLOPs trade that lets full 7B layer shapes
+        # train on one chip (SURVEY.md §7.1; ref analog: fleet recompute).
+        self.remat = False
 
     def forward(self, input_ids, position_offset=0, kv_caches=None):
         x = self.embed_tokens(input_ids)
         new_caches = []
+        use_remat = (self.remat and kv_caches is None
+                     and dispatch._is_tracer(x._data))
         for i, layer in enumerate(self.layers):
             if kv_caches is not None:
                 x, c = layer(x, position_offset, kv_caches[i])
                 new_caches.append(c)
+            elif use_remat:
+                import jax
+
+                def _call(xa, _layer=layer):
+                    return _layer(Tensor(xa), position_offset)._data
+
+                x = Tensor(jax.checkpoint(_call)(x._data),
+                           stop_gradient=x.stop_gradient)
             else:
                 x = layer(x, position_offset)
         x = self.norm(x)

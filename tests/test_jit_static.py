@@ -99,6 +99,25 @@ def test_jit_save_load_roundtrip(tmp_path):
     assert len(list(loaded.parameters())) == 4
 
 
+def test_jit_save_load_dynamic_batch(tmp_path):
+    """InputSpec None dims become jax.export symbolic dims: the loaded
+    program accepts any batch size (reference dynamic-dim support)."""
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / "dyn")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 3, 7):
+        x = paddle.Tensor(np.random.rand(bs, 8).astype(np.float32))
+        ref = model(x)
+        out = loaded(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_static_executor_over_loaded_program(tmp_path):
     import paddle_tpu.static as static
 

@@ -155,9 +155,12 @@ def test_fused_rope_matches_unfused():
     freqs = np.outer(t, inv)
     cos, sin = np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
 
+    # llama's internal rope rotates front-half/back-half pairs, i.e. the
+    # reference's use_neox_rotary_style=False layout.
     qt, kt = paddle.Tensor(q, stop_gradient=False), paddle.Tensor(k)
     oq, ok = incubate.nn.functional.fused_rotary_position_embedding(
-        qt, kt, cos=paddle.Tensor(cos), sin=paddle.Tensor(sin))
+        qt, kt, cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
+        use_neox_rotary_style=False)
     oq_ref, ok_ref = unfused(paddle.Tensor(q), paddle.Tensor(k),
                              paddle.Tensor(cos), paddle.Tensor(sin))
     np.testing.assert_allclose(np.asarray(oq._data), np.asarray(oq_ref._data),
@@ -169,6 +172,62 @@ def test_fused_rope_matches_unfused():
     loss.backward()
     np.testing.assert_allclose(np.asarray(qt.grad._data), q, atol=1e-4,
                                rtol=1e-4)
+
+
+def test_fused_rope_neox_adjacent_pairs():
+    """use_neox_rotary_style=True rotates adjacent pairs (x[2i], x[2i+1]) —
+    the reference convention ("every two adjacent numbers are calculated",
+    fused_rotary_position_embedding docstring)."""
+    from paddle_tpu import incubate
+
+    b, s, h, d = 1, 8, 2, 16
+    q = _rand(b, s, h, d, seed=18)
+    t = np.arange(s)
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    freqs = np.outer(t, inv)
+    cos, sin = np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+    oq = incubate.nn.functional.fused_rotary_position_embedding(
+        paddle.Tensor(q), cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
+        use_neox_rotary_style=True)
+    # manual adjacent-pair rotation
+    c = cos[None, :, None, :]
+    si = sin[None, :, None, :]
+    x1, x2 = q[..., 0::2], q[..., 1::2]
+    expect = np.stack([x1 * c - x2 * si, x2 * c + x1 * si], axis=-1
+                      ).reshape(q.shape)
+    np.testing.assert_allclose(np.asarray(oq._data), expect, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_fused_rope_full_d_table_halving():
+    """Full-D sin/cos tables are halved per layout: strided [0::2] for the
+    adjacent-pair (neox=True) duplicated layout, [:D/2] for rotate-half."""
+    from paddle_tpu import incubate
+
+    b, s, h, d = 1, 6, 2, 8
+    q = _rand(b, s, h, d, seed=19)
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    freqs = np.outer(np.arange(s), inv)  # [S, D/2]
+    cos_h = np.cos(freqs).astype(np.float32)
+    sin_h = np.sin(freqs).astype(np.float32)
+
+    for neox in (True, False):
+        if neox:  # adjacent duplication: full[2i] == full[2i+1] == half[i]
+            cos_f = np.repeat(cos_h, 2, axis=-1)
+            sin_f = np.repeat(sin_h, 2, axis=-1)
+        else:  # front/back duplication: full[i] == full[i+D/2] == half[i]
+            cos_f = np.concatenate([cos_h, cos_h], axis=-1)
+            sin_f = np.concatenate([sin_h, sin_h], axis=-1)
+        out_half = incubate.nn.functional.fused_rotary_position_embedding(
+            paddle.Tensor(q), cos=paddle.Tensor(cos_h),
+            sin=paddle.Tensor(sin_h), use_neox_rotary_style=neox)
+        out_full = incubate.nn.functional.fused_rotary_position_embedding(
+            paddle.Tensor(q), cos=paddle.Tensor(cos_f),
+            sin=paddle.Tensor(sin_f), use_neox_rotary_style=neox)
+        np.testing.assert_allclose(np.asarray(out_half._data),
+                                   np.asarray(out_full._data),
+                                   atol=1e-6, err_msg=f"neox={neox}")
 
 
 # ---------------------------------------------------------------------------
@@ -234,24 +293,24 @@ def test_rope_position_ids_and_interleaved():
     freqs = np.outer(np.arange(t), inv)
     cos, sin = np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
 
+    # position_ids path, default neox=True -> adjacent-pair rotation
     oq = incubate.nn.functional.fused_rotary_position_embedding(
         paddle.Tensor(q), cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
         position_ids=paddle.Tensor(pid))
-    # manual neox rotation with gathered positions
     c = cos[pid][:, :, None, :]
     si = sin[pid][:, :, None, :]
-    x1, x2 = q[..., : d // 2], q[..., d // 2:]
-    ref = np.concatenate([x1 * c - x2 * si, x2 * c + x1 * si], -1)
+    e, o = q[..., 0::2], q[..., 1::2]
+    ref = np.stack([e * c - o * si, o * c + e * si], -1).reshape(q.shape)
     np.testing.assert_allclose(np.asarray(oq._data), ref, atol=1e-5, rtol=1e-4)
 
-    # interleaved (GPT-J) style
+    # rotate-half (front/back segment) style = use_neox_rotary_style=False
     oqi = incubate.nn.functional.fused_rotary_position_embedding(
         paddle.Tensor(q), cos=paddle.Tensor(cos), sin=paddle.Tensor(sin),
         use_neox_rotary_style=False)
     ci = cos[:s][None, :, None, :]
     sii = sin[:s][None, :, None, :]
-    e, o = q[..., 0::2], q[..., 1::2]
-    ref_i = np.stack([e * ci - o * sii, o * ci + e * sii], -1).reshape(q.shape)
+    x1, x2 = q[..., : d // 2], q[..., d // 2:]
+    ref_i = np.concatenate([x1 * ci - x2 * sii, x2 * ci + x1 * sii], -1)
     np.testing.assert_allclose(np.asarray(oqi._data), ref_i, atol=1e-5,
                                rtol=1e-4)
 
