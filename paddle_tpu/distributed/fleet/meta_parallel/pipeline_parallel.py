@@ -7,24 +7,34 @@ static zero-bubble schedules
 
 Two faces, one API:
 
-1. **Eager scheduler** (`train_batch`): splits the batch into micro-batches
-   and walks them in the schedule's order (FThenB stores all micro
-   activations; 1F1B frees each after its backward — the memory profile that
-   defines the schedule). Stage-to-stage tensors cross via the autograd
-   graph; on hardware each stage's params live on its `pp` mesh coordinate so
-   boundary activations traverse ICI exactly like the reference's p2p
-   send/recv with shape handshake (`pp_utils/p2p_communication.py:51`).
+1. **Eager scheduler** (`train_batch`): a real pipelined executor.
+   `build_schedule` produces the slot-by-slot (stage, micro, F/B) work order
+   for FThenB / 1F1B / VPP-interleave — the same orders the reference's
+   schedulers emit — and the engine executes it: each stage's params are
+   `device_put` onto that stage's `pp`-coordinate sub-mesh, boundary
+   activations are detached and transferred to the next stage's devices (the
+   ICI p2p, reference `pp_utils/p2p_communication.py:51`), and each B step is
+   a per-stage `paddle.grad` VJP seeded with the upstream boundary cotangent.
+   Because XLA dispatch is async, F(s, m) on stage s's device overlaps
+   F(s+1, m-1) on stage s+1's — true pipelining under a single controller.
+   1F1B frees each micro's activations right after its backward; the engine
+   tracks live-activation counts so the schedules' defining memory profiles
+   are observable (`peak_live_activations`).
 
-2. **Compiled path** (`scan_pipeline`): the TPU-native form — all stages run
-   as ONE jitted program, micro-batches flow through a `lax.scan` whose
-   carry `ppermute`s stage outputs around the `pp` mesh axis (SURVEY.md §7.3
-   hard-part 2). Used by `to_static`/Engine; zero-bubble variants become
-   scan-schedule layouts instead of hand-written interceptor graphs
-   (`fleet_executor/carrier.h:50` has no role on TPU).
+2. **Compiled path** (`scan_pipeline` / `pipeline_train_step`): the
+   TPU-native form — all stages run as ONE jitted program, micro-batches
+   flow through a `lax.scan` whose carry `ppermute`s stage outputs around
+   the `pp` mesh axis (SURVEY.md §7.3 hard-part 2). `pipeline_train_step`
+   runs loss + backward inside the program (`jax.value_and_grad`
+   differentiates through the ppermute ring); schedule choice maps to the
+   memory policy (FThenB = save-everything, 1F1B = per-stage remat) and VPP
+   to chunked scans. Zero-bubble variants become scan-schedule layouts
+   instead of hand-written interceptor graphs (`fleet_executor/carrier.h:50`
+   has no role on TPU).
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -32,10 +42,118 @@ from ....core.tensor import Tensor
 from ..base.topology import get_hybrid_communicate_group
 from .pp_layers import PipelineLayer
 
-__all__ = ["PipelineParallel", "scan_pipeline"]
+__all__ = ["PipelineParallel", "scan_pipeline", "pipeline_train_step",
+           "build_schedule", "bubble_fraction", "analytic_bubble_fraction",
+           "pipeline_layer_to_stage_fn"]
 
+
+# ---------------------------------------------------------------------------
+# schedule construction (shared by the eager engine and the tests)
+# ---------------------------------------------------------------------------
+
+def build_schedule(schedule: str, n_stages: int, n_micro: int,
+                   n_chunks: int = 1) -> List[List[tuple]]:
+    """Slot-by-slot work order for an S-stage pipeline over M micro-batches.
+
+    Returns a list of time slots; each slot is a list of work items
+    ``(chunk, stage, micro, op)`` with op in {"F", "B"}; virtual stage
+    ``chunk*S + stage`` runs on device ``stage``. Items in one slot run
+    concurrently (different devices). Dependencies honoured:
+    F(vs, m) needs F(vs-1, m); B(vs, m) needs F(vs, m) and B(vs+1, m);
+    per virtual stage, micro-batches proceed in order.
+
+    The schedule string picks the per-device priority — the exact mechanism
+    that distinguishes the reference's schedulers
+    (`pipeline_parallel.py:245,1161,2018`):
+    - FThenB: forwards before backwards -> all M activations live at peak.
+    - 1F1B / VPP: backwards as soon as ready -> peak live activations per
+      stage is bounded by the pipeline depth, not M.
+    """
+    sched = schedule.upper().replace("-", "")
+    S, M, V = int(n_stages), int(n_micro), max(1, int(n_chunks))
+    n_virt = S * V
+    prefer_b = sched not in ("FTHENB",)
+    # per-virtual-stage FIFO queues (micro order)
+    f_q = {vs: list(range(M)) for vs in range(n_virt)}
+    b_q = {vs: list(range(M)) for vs in range(n_virt)}
+    fwd_done, bwd_done = set(), set()
+    live = {d: 0 for d in range(S)}  # in-flight micros (F issued, B not yet)
+    slots: List[List[tuple]] = []
+    total = 2 * n_virt * M
+    done = 0
+    while done < total:
+        slot = []
+        for d in range(S):
+            # 1F1B warmup bound: stage d keeps at most S-d micros in flight
+            # (the reference's warmup = S-d-1 forwards then strict 1F1B);
+            # interleave keeps a full S-wide window per extra chunk
+            # (Megatron interleaved warmup spans the chunk windows).
+            cap = (S - d) + S * (V - 1) if prefer_b else M * V
+            cands = []
+            for c in range(V):
+                vs = c * S + d
+                if f_q[vs] and live[d] < cap:
+                    m = f_q[vs][0]
+                    if vs == 0 or (vs - 1, m) in fwd_done:
+                        cands.append(("F", vs, c, m))
+                if b_q[vs]:
+                    m = b_q[vs][0]
+                    if (vs, m) in fwd_done and (
+                            vs == n_virt - 1 or (vs + 1, m) in bwd_done):
+                        cands.append(("B", vs, c, m))
+            if not cands:
+                continue
+            if prefer_b:
+                picks = [x for x in cands if x[0] == "B"] or cands
+            else:
+                picks = [x for x in cands if x[0] == "F"] or cands
+            op, vs, c, m = min(picks, key=lambda x: (x[3], x[2]))
+            slot.append((c, d, m, op))
+        if not slot:
+            raise RuntimeError("pipeline schedule deadlock (bug)")
+        # commit the slot's effects after selection so in-slot choices only
+        # see state from previous slots (items run concurrently)
+        for c, d, m, op in slot:
+            vs = c * S + d
+            if op == "F":
+                f_q[vs].pop(0)
+                fwd_done.add((vs, m))
+                live[d] += 1
+            else:
+                b_q[vs].pop(0)
+                bwd_done.add((vs, m))
+                live[d] -= 1
+            done += 1
+        slots.append(slot)
+    return slots
+
+
+def bubble_fraction(slots: List[List[tuple]], n_stages: int) -> float:
+    """Measured pipeline bubble: idle device-slots / total device-slots."""
+    work = sum(len(s) for s in slots)
+    total = n_stages * len(slots)
+    return 1.0 - work / total
+
+
+def analytic_bubble_fraction(schedule: str, n_stages: int, n_micro: int,
+                             n_chunks: int = 1) -> float:
+    """Closed-form bubble fraction (Megatron accounting): (S-1)/(V*M + S-1)
+    for VPP-interleave, (S-1)/(M + S-1) for FThenB/1F1B."""
+    S, M, V = n_stages, n_micro, max(1, n_chunks)
+    if schedule.upper().replace("-", "") in ("VPP", "INTERLEAVE"):
+        return (S - 1) / (V * M + S - 1)
+    return (S - 1) / (M + S - 1)
+
+
+# ---------------------------------------------------------------------------
+# the eager pipelined executor
+# ---------------------------------------------------------------------------
 
 class PipelineParallel:
+    """Pipelined train/eval over a `PipelineLayer` (reference
+    `PipelineParallel:245`). See the module docstring for the execution
+    model; `schedule_log` and `peak_live_activations` expose what ran."""
+
     def __init__(self, layers, hcg=None, strategy=None):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("PipelineParallel needs a PipelineLayer")
@@ -46,7 +164,85 @@ class PipelineParallel:
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.schedule = cfg.get("schedule_mode", "1F1B")
+        self.n_chunks = int(cfg.get("num_virtual_pipeline_stages", 1) or 1)
         self.total_loss = None
+        self.schedule_log: List[tuple] = []
+        self.peak_live_activations: dict = {}
+        self._segments = self._build_segments()
+        self._params_of_segment = [self._collect_segment_params(vs)
+                                   for vs in range(len(self._segments))]
+        self._stage_shardings = self._place_stages()
+
+    # -- placement -----------------------------------------------------------
+    def _build_segments(self):
+        """Partition the layer list into S*V virtual-stage segments."""
+        S = self._layers.num_stages
+        V = self.n_chunks
+        if V == 1:
+            return [self._layers.stage_layers(s) for s in range(S)]
+        fns = self._layers.run_function
+        n = len(fns)
+        n_virt = S * V
+        per = [n // n_virt + (1 if i < n % n_virt else 0)
+               for i in range(n_virt)]
+        bounds = [0]
+        for p in per:
+            bounds.append(bounds[-1] + p)
+        return [fns[bounds[i]:bounds[i + 1]] for i in range(n_virt)]
+
+    def _place_stages(self):
+        """device_put each stage's params onto its pp-coordinate sub-mesh.
+
+        The single-controller analog of each rank holding only its stage:
+        stage s's weights live on the devices at pp==s; boundary activations
+        move between the sub-meshes (ICI). Returns per-device shardings (or
+        None when there's no multi-device pp axis to place on)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        S = self._layers.num_stages
+        if self._hcg is None or S <= 1:
+            return None
+        mesh = self._hcg.get_hybrid_mesh().to_jax_mesh()
+        if "pp" not in mesh.axis_names or mesh.shape["pp"] != S:
+            return None
+        if mesh.devices.size < S:
+            return None
+        pp_axis = list(mesh.axis_names).index("pp")
+        rest_names = [n for n in mesh.axis_names if n != "pp"]
+        shardings = []
+        for s in range(S):
+            sub = np.take(mesh.devices, s, axis=pp_axis)
+            submesh = Mesh(sub, rest_names)
+            shardings.append(NamedSharding(submesh, P()))
+        n_virt = S * self.n_chunks
+        for vs in range(n_virt):
+            sh = shardings[vs % S]
+            for p in self._segment_params(vs):
+                if getattr(p, "_dist_meta", None) is not None:
+                    continue  # already placed by TP/sharding wrappers
+                p._data = jax.device_put(p._data, sh)
+        return shardings
+
+    def _collect_segment_params(self, vs: int):
+        from ....nn.layer.layers import Layer
+
+        out = []
+        for lyr, _ in self._segments[vs]:
+            if isinstance(lyr, Layer):
+                out.extend(p for p in lyr.parameters()
+                           if not p.stop_gradient)
+        return out
+
+    def _segment_params(self, vs: int):
+        return self._params_of_segment[vs]
+
+    def _to_stage(self, arr, vs: int):
+        import jax
+
+        if self._stage_shardings is None:
+            return arr
+        return jax.device_put(arr, self._stage_shardings[vs % self._layers.num_stages])
 
     # -- plumbing -----------------------------------------------------------
     def _split_micro(self, data):
@@ -64,38 +260,92 @@ class PipelineParallel:
                            Tensor(labels._data[sl], stop_gradient=True)))
         return micros
 
-    def _forward(self, x, label):
-        out = x
-        for stage in range(self._layers.num_stages):
-            out = self._layers.forward_stage(out, stage)
-        loss = self._layers._loss_fn(out, label) if self._layers._loss_fn \
-            else out
-        return loss
+    def _run_segment(self, vs: int, x: Tensor) -> Tensor:
+        for lyr, fwd in self._segments[vs]:
+            x = fwd(lyr, x) if fwd is not None else lyr(x)
+        return x
 
-    # -- schedules ----------------------------------------------------------
+    # -- the pipelined engine ------------------------------------------------
     def forward_backward_pipeline(self, data, scaler=None):
+        from ....core import autograd
+
         micros = self._split_micro(data)
-        n = len(micros)
-        total = None
-        if self.schedule.upper() in ("FTHENB", "F-THEN-B"):
-            losses = []
-            for x, y in micros:            # all forwards first (peak memory)
-                losses.append(self._forward(x, y))
-            for loss in losses:            # then all backwards
-                scaled = loss * (1.0 / n)
-                if scaler:
-                    scaled = scaler.scale(scaled)
-                scaled.backward()
-                total = loss if total is None else total + loss
-        else:  # 1F1B / VPP / ZBH1: fwd+bwd interleaved, activations freed
-            for x, y in micros:
-                loss = self._forward(x, y)
-                scaled = loss * (1.0 / n)
-                if scaler:
-                    scaled = scaler.scale(scaled)
-                scaled.backward()
-                total = loss if total is None else total + loss
-        self.total_loss = total * (1.0 / n)
+        M = len(micros)
+        S = self._layers.num_stages
+        V = self.n_chunks
+        n_virt = S * V
+        slots = build_schedule(self.schedule, S, M, V)
+
+        store = {}      # (vs, m) -> (x_in, out)  [out = y, or loss at last vs]
+        upstream = {}   # (vs, m) -> cotangent for vs's output
+        losses = [None] * M
+        live = {d: 0 for d in range(S)}
+        peak = {d: 0 for d in range(S)}
+        self.schedule_log = []
+        inv_m = 1.0 / M
+
+        for t, slot in enumerate(slots):
+            for c, d, m, op in slot:
+                vs = c * S + d
+                self.schedule_log.append((t, c, d, m, op))
+                if op == "F":
+                    if vs == 0:
+                        x_in = micros[m][0]
+                        if not x_in.stop_gradient:
+                            x_in = Tensor(self._to_stage(x_in._data, vs),
+                                          stop_gradient=False)
+                    else:
+                        prev = store[(vs - 1, m)][1]
+                        x_in = Tensor(self._to_stage(prev._data, vs),
+                                      stop_gradient=False)
+                    y = self._run_segment(vs, x_in)
+                    if vs == n_virt - 1:
+                        loss = self._layers._loss_fn(y, micros[m][1]) \
+                            if self._layers._loss_fn else y
+                        losses[m] = loss
+                        store[(vs, m)] = (x_in, loss)
+                    else:
+                        store[(vs, m)] = (x_in, y)
+                    live[d] += 1
+                    peak[d] = max(peak[d], live[d])
+                else:  # backward of virtual stage vs for micro m
+                    x_in, out = store.pop((vs, m))
+                    live[d] -= 1
+                    params = self._segment_params(vs)
+                    wants_x = vs > 0 and not x_in.stop_gradient
+                    inputs = ([x_in] if wants_x else []) + list(params)
+                    if vs == n_virt - 1:
+                        seed = out * inv_m
+                        if scaler is not None:
+                            seed = scaler.scale(seed)
+                        grads = autograd.grad([seed], inputs,
+                                              allow_unused=True) \
+                            if inputs else []
+                    else:
+                        g = upstream.pop((vs, m))
+                        grads = autograd.grad(
+                            [out], inputs,
+                            grad_outputs=[Tensor(self._to_stage(g._data, vs))],
+                            allow_unused=True) if inputs else []
+                    gi = 0
+                    if wants_x:
+                        gx = grads[0]
+                        gi = 1
+                        if gx is not None:
+                            upstream[(vs - 1, m)] = gx
+                    for p, gp in zip(params, grads[gi:]):
+                        if gp is None:
+                            continue
+                        if p.grad is None:
+                            p.grad = Tensor(gp._data, stop_gradient=True)
+                        else:
+                            p.grad = Tensor(p.grad._data + gp._data,
+                                            stop_gradient=True)
+        self.peak_live_activations = peak
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        self.total_loss = total * inv_m
         return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
@@ -112,17 +362,32 @@ class PipelineParallel:
 
     def eval_batch(self, data, compute_loss=True):
         micros = self._split_micro(data)
+        n_virt = self._layers.num_stages * self.n_chunks
         total = None
         from ....core.autograd import no_grad
 
         with no_grad():
             for x, y in micros:
-                loss = self._forward(x, y)
-                total = loss if total is None else total + loss
+                for vs in range(n_virt):
+                    x = Tensor(self._to_stage(x._data, vs),
+                               stop_gradient=True)
+                    x = self._run_segment(vs, x)
+                out = self._layers._loss_fn(x, y) \
+                    if (compute_loss and self._layers._loss_fn) else x
+                total = out if total is None else total + out
         return total * (1.0 / len(micros))
 
     def forward(self, *args, **kwargs):
-        return self._layers(*args, **kwargs)
+        if self._stage_shardings is None:
+            return self._layers(*args, **kwargs)
+        # placed pipeline: chain segments with inter-stage transfers
+        x = args[0]
+        n_virt = self._layers.num_stages * self.n_chunks
+        for vs in range(n_virt):
+            x = Tensor(self._to_stage(x._data, vs),
+                       stop_gradient=x.stop_gradient)
+            x = self._run_segment(vs, x)
+        return x
 
     __call__ = forward
 
@@ -130,14 +395,20 @@ class PipelineParallel:
         return getattr(self._layers, item)
 
 
-def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
-                  axis_name: str = "pp"):
-    """Compiled 1F1B-style pipeline as one XLA program (the TPU-native path).
+# ---------------------------------------------------------------------------
+# the compiled (one-XLA-program) path
+# ---------------------------------------------------------------------------
 
-    stage_fn(params, x) -> y: one pipeline stage, identical structure per
-    stage. stage_params: pytree whose leaves are stacked on dim0 over the
-    `pp` mesh axis (stage i's weights live on pp coordinate i).
-    inputs: [n_micro, micro_batch, ...] micro-batch stack.
+def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
+                  axis_name: str = "pp", mesh=None):
+    """Compiled pipeline as one XLA program (the TPU-native path).
+
+    stage_fn(params, x) -> y: one pipeline stage; per-stage weights differ
+    but the pytree structure and the x->y aval must match across stages
+    (the transformer-stack case — embed/head belong in `first_fn`/`last_fn`
+    of `pipeline_train_step`). stage_params: pytree whose leaves are stacked
+    on dim0 over the `pp` mesh axis (stage i's weights live on pp coordinate
+    i). inputs: [n_micro, micro_batch, ...] micro-batch stack.
 
     Runs inside `shard_map` over the pp axis: each step every stage works on
     a different micro-batch; the carry `ppermute`s stage outputs to the next
@@ -147,7 +418,9 @@ def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
     import jax
     import jax.numpy as jnp
 
-    n_stages = _static_axis_size(axis_name)
+    if mesh is None:
+        mesh = _current_mesh()
+    n_stages = mesh.shape[axis_name]
 
     def per_stage(params, xs):
         # params: this stage's weights (leading stacked dim removed by
@@ -181,16 +454,112 @@ def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
 
     from jax.sharding import PartitionSpec as P
 
-    mesh = _current_mesh()
     fn = jax.shard_map(per_stage, mesh=mesh,
                        in_specs=(P(axis_name), P()), out_specs=P(),
                        check_vma=False)
     return fn(stage_params, inputs)
 
 
-def _static_axis_size(axis_name):
-    mesh = _current_mesh()
-    return mesh.shape[axis_name]
+def pipeline_train_step(stage_fn, stacked_params, inputs, labels, *,
+                        loss_fn, n_micro: int, axis_name: str = "pp",
+                        schedule: str = "1F1B", n_chunks: int = 1,
+                        first_fn=None, first_params=None,
+                        last_fn=None, last_params=None, mesh=None):
+    """Forward + loss + backward of a pipelined model as ONE compilable
+    computation. Returns ``(loss, (stacked_grads, first_grads, last_grads))``.
+
+    - `first_fn(first_params, inputs)` runs before the pipeline (embedding),
+      `last_fn(last_params, y)` after it (head); both replicated over pp.
+    - schedule: "FThenB" saves all scan residuals (peak activation memory
+      scales with n_micro); "1F1B"/"VPP" wrap the stage in `jax.checkpoint`
+      so backward rematerialises per step — the compiled counterpart of the
+      1F1B bounded-memory profile.
+    - n_chunks > 1 (VPP): stacked_params leaves carry an extra leading chunk
+      dim [V, S, ...]; micro-batches traverse V chained scans — the
+      interleaved virtual-stage layout (reference
+      `PipelineParallelWithInterleave:1161`).
+
+    Differentiating through `ppermute` gives the reverse-direction cotangent
+    ring for free — the backward p2p the reference hand-writes.
+    """
+    import jax
+
+    sched = schedule.upper().replace("-", "")
+    sfn = stage_fn if sched == "FTHENB" else jax.checkpoint(stage_fn)
+
+    def full(all_params, inputs, labels):
+        stacked, fp, lp = all_params
+        x = first_fn(fp, inputs) if first_fn is not None else inputs
+        mb = x.shape[0] // n_micro
+        micros = x.reshape((n_micro, mb) + tuple(x.shape[1:]))
+        if n_chunks > 1:
+            for c in range(n_chunks):
+                chunk = jax.tree.map(lambda p: p[c], stacked)
+                micros = scan_pipeline(sfn, chunk, micros, n_micro,
+                                       axis_name, mesh=mesh)
+        else:
+            micros = scan_pipeline(sfn, stacked, micros, n_micro,
+                                   axis_name, mesh=mesh)
+        y = micros.reshape((n_micro * mb,) + tuple(micros.shape[2:]))
+        out = last_fn(lp, y) if last_fn is not None else y
+        return loss_fn(out, labels)
+
+    loss, grads = jax.value_and_grad(full)(
+        (stacked_params, first_params, last_params), inputs, labels)
+    return loss, grads
+
+
+def pipeline_layer_to_stage_fn(pipe: PipelineLayer):
+    """Bridge a `PipelineLayer` to the compiled path: returns
+    ``(stage_fn, stacked_params)`` with per-stage parameter pytrees stacked
+    on dim0. Requires stage segments with identical layer/param structure
+    (the repeated-block case); raises otherwise."""
+    import jax.numpy as jnp
+
+    from ....jit.functional import functional_call
+    from ....nn.layer.layers import Layer
+
+    segs = [pipe.stage_layers(s) for s in range(pipe.num_stages)]
+    per_stage = []
+    for seg in segs:
+        ps = []
+        for lyr, _ in seg:
+            if isinstance(lyr, Layer):
+                ps.extend(p for _, p in sorted(lyr.named_parameters()))
+        per_stage.append(ps)
+    shapes0 = [tuple(p.shape) for p in per_stage[0]]
+    for s, ps in enumerate(per_stage[1:], 1):
+        if [tuple(p.shape) for p in ps] != shapes0:
+            raise ValueError(
+                f"stage {s} param structure {[tuple(p.shape) for p in ps]} "
+                f"differs from stage 0 {shapes0}; the compiled pipeline "
+                "needs homogeneous stages (keep embed/head in "
+                "first_fn/last_fn)")
+    stacked = {f"p{i}": jnp.stack([jnp.asarray(ps[i]._data)
+                                   for ps in per_stage])
+               for i in range(len(shapes0))}
+    template = segs[0]
+
+    def stage_fn(params, x):
+        out = Tensor(x)
+        k = 0
+        for lyr, fwd in template:
+            if isinstance(lyr, Layer):
+                names = [n for n, _ in sorted(lyr.named_parameters())]
+                sub = {n: params[f"p{k + j}"] for j, n in enumerate(names)}
+                k += len(names)
+                if fwd is not None:
+                    from ....jit.functional import _swapped
+
+                    with _swapped(lyr, sub):
+                        out = fwd(lyr, out)
+                else:
+                    out = functional_call(lyr, sub, out)
+            else:
+                out = fwd(lyr, out) if fwd is not None else lyr(out)
+        return out._data
+
+    return stage_fn, stacked
 
 
 def _current_mesh():

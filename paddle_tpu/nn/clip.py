@@ -58,6 +58,14 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
+    @staticmethod
+    def _dev_key(arr):
+        sh = getattr(arr, "sharding", None)
+        try:
+            return tuple(sorted(d.id for d in sh.device_set))
+        except Exception:
+            return None
+
     def __call__(self, params_grads):
         import jax
         import jax.numpy as jnp
@@ -66,7 +74,18 @@ class ClipGradByGlobalNorm(ClipGradBase):
               if g is not None and getattr(p, "need_clip", True)]
         if not gs:
             return params_grads
-        global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+        # Grads may live on disjoint device sets (pipeline stages place each
+        # stage's params on its pp coordinate): reduce each grad's square sum
+        # where it lives, hop the scalar partials to one device to combine,
+        # then hop the scale back to each grad's devices.
+        keys = {self._dev_key(g) for g in gs}
+        if len(keys) == 1:
+            global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+        else:
+            home = gs[0].sharding
+            partials = [jax.device_put(jnp.sum(g.astype(jnp.float32) ** 2),
+                                       home) for g in gs]
+            global_sq = sum(partials)
         global_norm = jnp.sqrt(global_sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
@@ -74,7 +93,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
-            out.append((p, Tensor(g._data * scale.astype(g._data.dtype),
+            s = scale if len(keys) == 1 else jax.device_put(scale,
+                                                            g._data.sharding)
+            out.append((p, Tensor(g._data * s.astype(g._data.dtype),
                                   stop_gradient=True)))
         return out
 

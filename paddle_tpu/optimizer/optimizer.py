@@ -185,6 +185,18 @@ class Optimizer:
             return self._grad_clip(params_grads)
         return params_grads
 
+    @staticmethod
+    def _placement_key(p):
+        """Device-set key so the fused step runs one program per placement
+        group (pipeline stages place params on different pp-coordinate
+        devices; one jit over mixed devices is invalid — and per-stage
+        updates dispatch async, in parallel across stages)."""
+        sh = getattr(p._data, "sharding", None)
+        try:
+            return tuple(sorted(d.id for d in sh.device_set))
+        except Exception:
+            return None
+
     def step(self):
         params_grads = [(p, p.grad) for p in self._params
                         if isinstance(p, Tensor) and not p.stop_gradient
@@ -193,13 +205,20 @@ class Optimizer:
             return
         params_grads = self._clip_grads(params_grads)
         self._global_step += 1
+        groups = {}
+        for p, g in params_grads:
+            groups.setdefault(self._placement_key(p), []).append((p, g))
+        for dev_key, pg in groups.items():
+            self._step_group(pg, dev_key)
+
+    def _step_group(self, params_grads, dev_key):
         for p, _ in params_grads:
             self._ensure_state(p)
         # static per-param decay/lr config is part of the executable key, so the
         # jitted program re-specialises only when the trainable set changes
         wds = tuple(self._wd_of(p) for p, _ in params_grads)
         lr_mults = tuple(self._lr_mult_of(p) for p, _ in params_grads)
-        key = (wds, lr_mults)
+        key = (wds, lr_mults, dev_key)
         fn = self._jitted_updates.get(key)
         if fn is None:
             fn = self._jitted_updates[key] = self._build_step_fn(wds, lr_mults)
