@@ -217,11 +217,33 @@ def _differentiable(a) -> bool:
     return a is not None and is_inexact_np(a.dtype)
 
 
+# Profiler hook: when set, every eager op dispatch is timed and reported as
+# (op_name, t_start, t_end) — the host-span source for paddle.profiler
+# (reference analog: RecordOpInfoSupplement in the host tracer).
+_profile_cb: Optional[Callable] = None
+
+
+def set_profile_hook(fn: Optional[Callable]):
+    global _profile_cb
+    _profile_cb = fn
+
+
 def apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     """Run one op on Tensor inputs; returns Tensor or list of Tensors.
 
     The eager hot loop (§3.1 steps 2-7 of SURVEY.md collapsed into one cache hit).
     """
+    if _profile_cb is not None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = _apply(op_name, tensor_inputs, attrs)
+        _profile_cb(op_name, t0, _time.perf_counter())
+        return out
+    return _apply(op_name, tensor_inputs, attrs)
+
+
+def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     from .tensor import Tensor
 
     op = _OP_REGISTRY[op_name]

@@ -6,6 +6,8 @@ arrays, eager collectives are jitted XLA programs over ICI/DCN, rendezvous is
 the JAX coordination service.
 """
 from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import fleet, sharding  # noqa: F401
 from . import ring_attention  # noqa: F401
 from .ring_attention import ring_flash_attention, ulysses_attention  # noqa: F401

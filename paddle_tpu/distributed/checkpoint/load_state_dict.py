@@ -1,0 +1,103 @@
+"""Sharded distributed checkpoint load with reshard-on-load.
+
+Analog of `python/paddle/distributed/checkpoint/load_state_dict.py:467`.
+The destination state_dict's tensors already carry their TARGET sharding
+(mesh/placements at load time, which may differ from save time — dp2xmp4
+checkpoints load onto dp4xmp2). For each destination shard the loader
+computes the overlap with every saved shard of the same tensor (the
+reference's read-items plan) and assembles just those bytes, then builds the
+device array with `jax.make_array_from_callback` so each device receives
+only its slice.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import Metadata
+from .save_state_dict import _wait_pending
+
+__all__ = ["load_state_dict"]
+
+
+class _StorageReader:
+    """Lazily loads per-device .distcp shard files, caching by file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cache: Dict[str, dict] = {}
+
+    def blob(self, fname: str, key, offset):
+        blobs = self._cache.get(fname)
+        if blobs is None:
+            with open(os.path.join(self.path, fname), "rb") as f:
+                blobs = self._cache[fname] = pickle.load(f)
+        return blobs[(key, tuple(offset))]
+
+
+def _assemble(dest_index, global_shape, saved_metas, storage, reader, key,
+              dtype):
+    """Fill the destination slice `dest_index` (tuple of slices) from
+    overlapping saved shards."""
+    from .metadata import LocalTensorIndex
+
+    lo = [0 if s.start is None else int(s.start) for s in dest_index]
+    hi = [global_shape[i] if s.stop is None else int(s.stop)
+          for i, s in enumerate(dest_index)]
+    shape = [h - l for l, h in zip(lo, hi)]
+    out = np.zeros(shape, dtype=dtype)
+    for m in saved_metas:
+        s_lo = list(m.global_offset)
+        s_hi = [o + s for o, s in zip(m.global_offset, m.local_shape)]
+        ilo = [max(a, b) for a, b in zip(lo, s_lo)]
+        ihi = [min(a, b) for a, b in zip(hi, s_hi)]
+        if any(a >= b for a, b in zip(ilo, ihi)):
+            continue  # no overlap
+        fname = storage[LocalTensorIndex(key, tuple(m.global_offset))]
+        src = reader.blob(fname, key, m.global_offset)
+        src_sl = tuple(slice(a - o, b - o)
+                       for a, b, o in zip(ilo, ihi, s_lo))
+        dst_sl = tuple(slice(a - o, b - o) for a, b, o in zip(ilo, ihi, lo))
+        out[dst_sl] = src[src_sl]
+    return out
+
+
+def load_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    offload: bool = False) -> None:
+    """Load ``path`` into ``state_dict`` IN PLACE, resharding each tensor to
+    the destination's current sharding."""
+    import jax
+
+    _wait_pending()  # async saves must be on disk before we read
+    with open(os.path.join(path, "0.metadata"), "rb") as f:
+        meta: Metadata = pickle.load(f)
+    reader = _StorageReader(path)
+
+    for key, t in state_dict.items():
+        if key not in meta.state_dict_metadata:
+            raise KeyError(f"checkpoint at {path} has no tensor '{key}'")
+        saved = meta.state_dict_metadata[key]
+        arr = t._data if isinstance(t, Tensor) else t
+        global_shape = tuple(int(s) for s in arr.shape)
+        dtype = np.dtype(saved[0].dtype)
+        sharding = getattr(arr, "sharding", None)
+        if sharding is None or not hasattr(arr, "addressable_shards"):
+            full = _assemble(tuple(slice(0, s) for s in global_shape),
+                             global_shape, saved, meta.storage_metadata,
+                             reader, key, dtype)
+            new = jax.numpy.asarray(full)
+        else:
+            new = jax.make_array_from_callback(
+                global_shape, sharding,
+                lambda idx, _k=key, _s=saved, _d=dtype: _assemble(
+                    idx, global_shape, _s, meta.storage_metadata, reader,
+                    _k, _d))
+        if isinstance(t, Tensor):
+            t._data = new.astype(arr.dtype) if new.dtype != arr.dtype else new
+        else:
+            state_dict[key] = new

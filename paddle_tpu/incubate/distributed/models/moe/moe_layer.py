@@ -269,14 +269,53 @@ class MoELayer(Layer):
             p._data = st._data
             p._dist_meta = st._dist_meta
 
+    def _ep_mesh(self):
+        """(jax_mesh, axis_name) when the all-to-all EP path applies."""
+        from .....distributed.process_mesh import get_mesh
+
+        if not getattr(self, "use_alltoall", True) or self.experts is None:
+            return None
+        if not isinstance(self.gate, NaiveGate):
+            return None
+        mesh = get_mesh()
+        if mesh is None or "ep" not in mesh.dim_names:
+            return None
+        n = mesh.get_dim_size("ep")
+        if n <= 1 or self.num_experts % n != 0:
+            return None
+        return mesh.to_jax_mesh(), "ep"
+
     def forward(self, x):
-        """x: [..., H] — flattened to tokens internally."""
+        """x: [..., H] — flattened to tokens internally. With an `ep` mesh
+        axis the layer routes through the all-to-all dispatch/combine
+        (`moe_ep_forward`); otherwise the dense GShard einsum formulation."""
         from .....ops import manipulation as man
 
         orig_shape = list(x.shape)
         h = orig_shape[-1]
         xt = man.reshape(as_tensor(x), [-1, h])       # [T, H]
         t = xt.shape[0]
+
+        ep = self._ep_mesh()
+        if ep is not None:
+            mesh, axis = ep
+            n = mesh.shape[axis]
+            if t % n == 0:
+                t_local = t // n
+                cap = max(1, int(self.capacity_factor * t_local *
+                                 max(1, self.top_k) / self.num_experts))
+                ex = self.experts
+                y, aux = dispatch.apply(
+                    "moe_ep_forward",
+                    [xt, self.gate.gate_proj.weight, ex.w1, ex.b1, ex.w2,
+                     ex.b2],
+                    {"top_k": self.top_k, "capacity": cap,
+                     "activation": ex.activation, "axis_name": axis,
+                     "mesh": mesh})
+                self.gate.loss = aux
+                self.aux_loss = aux
+                return man.reshape(y, orig_shape)
+
         probs = self.gate(xt)                          # [T, E]
         if isinstance(self.gate, (SwitchGate, GShardGate)):
             aux = dispatch.apply("moe_aux_loss", [probs], {})
@@ -302,6 +341,93 @@ class MoELayer(Layer):
         # combine: [T,E,C] x [E,C,H] -> [T,H]
         out = dispatch.apply("moe_einsum_combine", [combine, expert_out], {})
         return man.reshape(out, orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel all-to-all path (the real EP formulation)
+# ---------------------------------------------------------------------------
+
+def _ep_local_fn(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, axis_name,
+                 activation):
+    """Per-ep-shard MoE: gate -> scatter into a [E, C, H] send buffer ->
+    all_to_all -> local experts -> all_to_all back -> gather-combine.
+
+    The TPU-native `global_scatter`/`global_gather`
+    (`distributed/utils/moe_utils.py:20,153`): token routing is a scatter
+    into per-(expert, source-shard) capacity slots and the device exchange
+    is `lax.all_to_all` over the ep axis — per-device memory is
+    O(E*C*H) = O(top_k * capacity_factor * T_local * H), never the dense
+    [T, E, C] one-hot.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    t, hdim = x.shape
+    e_total = gate_w.shape[1]
+    n = jax.lax.axis_size(axis_name)
+    logits = jnp.einsum("th,he->te", x, gate_w,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)                  # [t, k]
+    topv = topv.astype(x.dtype)
+    if top_k > 1:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    # priority order: every token's top-1 before any top-2 (GShard)
+    ti = topi.T.reshape(-1)                                   # [k*t]
+    tv = topv.T.reshape(-1)
+    sel = jax.nn.one_hot(ti, e_total, dtype=jnp.int32)        # [k*t, E]
+    pos_all = jnp.cumsum(sel, axis=0) - 1
+    pos = jnp.take_along_axis(pos_all, ti[:, None], axis=1)[:, 0]
+    keep = (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1)
+    tok = jnp.tile(jnp.arange(t), top_k)
+    xs = x[tok] * keep[:, None].astype(x.dtype)
+    send = jnp.zeros((e_total, capacity, hdim), x.dtype)
+    send = send.at[ti, pos_c].add(xs)
+    # exchange: [E, C, H] -> [E/n, n*C, H] (each device keeps its experts,
+    # receives every shard's capacity slots for them)
+    recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=1,
+                              tiled=True)
+    act = {"gelu": jax.nn.gelu, "relu": lambda v: jnp.maximum(v, 0),
+           "silu": jax.nn.silu}[activation]
+    h = jnp.einsum("ech,ehf->ecf", recv, w1,
+                   preferred_element_type=jnp.float32).astype(x.dtype) + b1
+    h = act(h)
+    out = jnp.einsum("ecf,efh->ech", h, w2,
+                     preferred_element_type=jnp.float32).astype(x.dtype) + b2
+    # inverse exchange back to the token owners: [E/n, n*C, H] -> [E, C, H]
+    back = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                              tiled=True)
+    gathered = back[ti, pos_c] * (tv * keep.astype(x.dtype))[:, None]
+    y = gathered.reshape(top_k, t, hdim).sum(axis=0)
+    # GShard aux loss on the local shard, averaged over the ep group
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(topi[:, 0], e_total, dtype=probs.dtype).mean(axis=0)
+    aux = e_total * jnp.sum(me * jax.lax.stop_gradient(ce))
+    aux = jax.lax.pmean(aux, axis_name)
+    return y, aux
+
+
+def _ep_moe_fn(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, activation,
+               axis_name, mesh):
+    """shard_map wrapper: tokens sharded over ep (dim 0), experts sharded
+    over ep (dim 0), gate replicated."""
+    import functools
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    local = functools.partial(_ep_local_fn, top_k=top_k, capacity=capacity,
+                              axis_name=axis_name, activation=activation)
+    ep = P(axis_name)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(ep, P(), ep, ep, ep, ep),
+        out_specs=(ep, P()), check_vma=False)
+    return fn(x, gate_w, w1, b1, w2, b2)
+
+
+dispatch.register_op("moe_ep_forward", _ep_moe_fn, multi_out=True)
 
 
 def _einsum_dispatch_fn(disp, x):

@@ -1,0 +1,23 @@
+"""paddle.profiler — host spans + device traces + Chrome export.
+
+Analog of `python/paddle/profiler/` (`profiler.py:358` Profiler,
+`:129` make_scheduler, `utils.py` RecordEvent, `profiler_statistic.py`
+summary). TPU-native split of responsibilities:
+
+- **Host spans**: python ranges (`RecordEvent`) and per-op eager dispatch
+  timings (a hook in `core.dispatch`) recorded in-process — the role of the
+  reference's `host_tracer.cc`.
+- **Device timeline**: delegated to `jax.profiler` (XLA's own tracer) —
+  `start_trace`/`stop_trace` around the RECORD window writes a TensorBoard/
+  XPlane trace with per-HLO device ops, the role of CUPTI in the reference.
+- **Export**: host spans serialise to chrome://tracing JSON next to the
+  device trace dir.
+"""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+                       SortedKeys, SummaryView, export_chrome_tracing,
+                       export_protobuf, load_profiler_result, make_scheduler)
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "SortedKeys", "SummaryView", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result"]

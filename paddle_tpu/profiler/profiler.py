@@ -1,0 +1,343 @@
+"""Profiler implementation. See package docstring; reference
+`python/paddle/profiler/profiler.py:358` (Profiler), `:129`
+(make_scheduler), `utils.py:30` (RecordEvent)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, List, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "SortedKeys", "SummaryView", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed state machine (reference `profiler.py:129`):
+    skip_first CLOSED steps, then cycles of closed/ready/record, the last
+    record step of each cycle returning RECORD_AND_RETURN."""
+    cycle = closed + ready + record
+    if record <= 0:
+        raise ValueError("record steps must be > 0")
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat > 0 and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_state_fn(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # profile everything between start and stop
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "kind")
+
+    def __init__(self, name, start, end, tid, kind):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.kind = kind  # "op" | "range" | "step"
+
+
+class _Recorder:
+    """In-process host-span collector (the host_tracer role)."""
+
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self._lock = threading.Lock()
+
+    def add(self, name, start, end, kind):
+        with self._lock:
+            self.events.append(_HostEvent(name, start, end,
+                                          threading.get_ident(), kind))
+
+
+_active_recorder: Optional[_Recorder] = None
+
+
+class RecordEvent:
+    """User-defined host range (reference `utils.py:30`); context manager or
+    explicit begin()/end()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        if _active_recorder is not None:
+            _active_recorder.add(self.name, self._t0, time.perf_counter(),
+                                 "range")
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome://tracing JSON
+    (reference `profiler.py:103`)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      ".pb.trace.json")
+        prof._export_chrome(path)
+        prof.last_export_path = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Parity alias: the portable artifact on TPU is the chrome JSON +
+    jax.profiler XPlane dir (reference exports .pb)."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """reference `paddle.profiler.Profiler` (`profiler.py:358`).
+
+    targets are accepted for parity; on this backend host spans are always
+    collected and the device timeline comes from `jax.profiler` when any
+    accelerator target is requested (TPU/GPU/CUSTOM_DEVICE).
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None, with_flops: bool = False):
+        if scheduler is None:
+            self._scheduler = _default_state_fn
+        elif isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._targets = set(targets or [ProfilerTarget.CPU,
+                                        ProfilerTarget.TPU])
+        self._device_trace = any(t != ProfilerTarget.CPU
+                                 for t in self._targets)
+        self.current_state = ProfilerState.CLOSED
+        self.step_num = 0
+        self.recorder: Optional[_Recorder] = None
+        self.last_export_path = None
+        self._device_trace_dir = None
+        self._device_tracing = False
+        self._step_t0 = None
+        self._step_times: List[float] = []
+        self._batch_sizes: List[int] = []
+        self._epoch = 0
+
+    # -- tracer control ------------------------------------------------------
+    def _enable(self):
+        global _active_recorder
+        from ..core import dispatch
+
+        if self.recorder is None:
+            self.recorder = _Recorder()
+        _active_recorder = self.recorder
+        rec = self.recorder
+        dispatch.set_profile_hook(
+            lambda name, t0, t1: rec.add(name, t0, t1, "op"))
+        if self._device_trace and not self._device_tracing:
+            try:
+                import jax
+
+                self._device_trace_dir = self._device_trace_dir or \
+                    os.path.join("profiler_log", f"jax_{os.getpid()}")
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _disable(self):
+        global _active_recorder
+        from ..core import dispatch
+
+        dispatch.set_profile_hook(None)
+        _active_recorder = None
+        if self._device_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # -- public API ----------------------------------------------------------
+    def start(self):
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN) and \
+                not self._timer_only:
+            self._enable()
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN) and \
+                not self._timer_only:
+            self._disable()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            if self.recorder is not None and self.current_state in (
+                    ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self.recorder.add(f"ProfileStep#{self.step_num}",
+                                  self._step_t0, now, "step")
+            self._step_times.append(now - self._step_t0)
+            if num_samples:
+                self._batch_sizes.append(num_samples)
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev not in recording and self.current_state in recording and \
+                not self._timer_only:
+            self._enable()
+        if prev in recording and self.current_state not in recording:
+            if not self._timer_only:
+                self._disable()
+                if prev == ProfilerState.RECORD_AND_RETURN or \
+                        self.current_state == ProfilerState.CLOSED:
+                    if self._on_trace_ready is not None:
+                        self._on_trace_ready(self)
+        self._step_t0 = time.perf_counter()
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        dt = self._step_times[-1]
+        msg = f"step {self.step_num}: {dt * 1e3:.2f} ms/step"
+        if self._batch_sizes:
+            ips = self._batch_sizes[-1] / dt
+            msg += f", ips: {ips:.2f} {unit}/s"
+        return msg
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- export / summary ----------------------------------------------------
+    def _export_chrome(self, path: str):
+        events = []
+        rec = self.recorder
+        base = min((e.start for e in rec.events), default=0.0) if rec else 0.0
+        if rec:
+            for e in rec.events:
+                events.append({
+                    "name": e.name, "ph": "X", "cat": e.kind,
+                    "ts": (e.start - base) * 1e6,
+                    "dur": (e.end - e.start) * 1e6,
+                    "pid": os.getpid(), "tid": e.tid,
+                })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "deviceTraceDir": self._device_trace_dir}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms",
+                views=None) -> str:
+        """Aggregated host-span table (reference profiler_statistic)."""
+        if self.recorder is None or not self.recorder.events:
+            return "no profiling data"
+        agg = {}
+        for e in self.recorder.events:
+            tot, cnt, mx = agg.get(e.name, (0.0, 0, 0.0))
+            d = e.end - e.start
+            agg[e.name] = (tot + d, cnt + 1, max(mx, d))
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                 f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"]
+        for name, (tot, cnt, mx) in rows:
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot * unit:>14.3f}"
+                         f"{tot / cnt * unit:>12.3f}{mx * unit:>12.3f}")
+        return "\n".join(lines)

@@ -1,0 +1,98 @@
+"""Profiler: host spans, step scheduler, Chrome export, summary.
+
+Reference analogs: `python/paddle/profiler/profiler.py:358,129`,
+`utils.py:30`.
+"""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu import nn
+
+
+def _train_steps(model, opt, n, bs=4):
+    x = paddle.Tensor(np.random.rand(bs, 8).astype(np.float32))
+    for _ in range(n):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def test_profiler_records_spans_and_exports(tmp_path):
+    model = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    exported = {}
+
+    def on_ready(prof):
+        p = str(tmp_path / "trace.json")
+        prof.export(p)
+        exported["path"] = p
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                             on_trace_ready=on_ready)
+    prof.start()
+    for _ in range(3):
+        with profiler.RecordEvent("train_step"):
+            _train_steps(model, opt, 1)
+        prof.step(num_samples=4)
+    prof.stop()
+
+    # host spans: op dispatches + the user range + step markers
+    kinds = {e.kind for e in prof.recorder.events}
+    assert {"op", "range", "step"} <= kinds
+    names = {e.name for e in prof.recorder.events}
+    assert "train_step" in names
+    assert any(n.startswith("ProfileStep#") for n in names)
+    assert "linear" in names  # the Linear layer op dispatch was timed
+
+    assert os.path.exists(exported["path"])
+    data = json.load(open(exported["path"]))
+    assert data["traceEvents"], "empty chrome trace"
+    ev = data["traceEvents"][0]
+    assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+
+    s = prof.summary()
+    assert "linear" in s and "Calls" in s
+    assert "ms/step" in prof.step_info()
+
+
+def test_make_scheduler_states():
+    fn = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                                 skip_first=1)
+    S = profiler.ProfilerState
+    expect = [S.CLOSED,                      # skip_first
+              S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 1
+              S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # cycle 2
+              S.CLOSED, S.CLOSED]            # repeat exhausted
+    assert [fn(i) for i in range(len(expect))] == expect
+
+
+def test_scheduler_gates_recording(tmp_path):
+    """Only RECORD-state steps contribute op spans."""
+    model = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    prof = profiler.Profiler(
+        targets=[profiler.ProfilerTarget.CPU],
+        scheduler=profiler.make_scheduler(closed=2, ready=0, record=2,
+                                          repeat=1),
+        on_trace_ready=lambda p: None)
+    prof.start()   # step 0: CLOSED
+    counts = []
+    for _ in range(4):
+        _train_steps(model, opt, 1)
+        counts.append(len(prof.recorder.events) if prof.recorder else 0)
+        prof.step()
+    prof.stop()
+    assert counts[0] == 0 and counts[1] == 0      # closed steps: no spans
+    assert counts[3] > counts[1]                   # record steps added spans
+
+
+def test_record_event_outside_profiler_is_noop():
+    with profiler.RecordEvent("orphan"):
+        pass  # must not raise without an active profiler
