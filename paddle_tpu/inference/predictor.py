@@ -1,0 +1,250 @@
+"""Inference Config / Predictor — the serving entry point (L9).
+
+Parity target: `paddle/fluid/inference/api/analysis_predictor.h:105`
+(`AnalysisPredictor`) and the python surface `paddle.inference`
+(`Config`, `create_predictor`, handle-based IO). The reference predictor
+loads a serialized program, runs analysis/optimization passes, and executes
+with zero-copy input/output handles.
+
+TPU design: the "analysis passes" are XLA — the saved artifact is portable
+StableHLO (`paddle_tpu.jit.save`), deserialized once and compiled by PJRT on
+first run; handles hold device arrays and only copy at the host boundary
+(`copy_from_cpu` / `copy_to_cpu`), matching the reference's ZeroCopyTensor.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
+           "PlaceType", "DataType", "get_version"]
+
+
+def get_version() -> str:
+    import jax
+
+    return f"paddle_tpu-inference jax-{jax.__version__}"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+class Config:
+    """`paddle.inference.Config` analog (AnalysisConfig).
+
+    Pass-management and GPU/TensorRT toggles are accepted for API parity;
+    on this backend graph optimization is XLA's job, so they only record
+    intent (introspectable via `summary()`).
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._model_path = prog_file
+        self._params_file = params_file
+        self._device = None          # None -> default backend
+        self._device_id = 0
+        self._memory_optim = True
+        self._ir_optim = True
+        self._cpu_math_threads = 1
+        self._enable_profile = False
+        self._exec_stream = None
+        self._disabled = False
+
+    # --- model path ---
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._model_path = prog_file
+        self._params_file = params_file
+
+    def model_dir(self) -> Optional[str]:
+        return os.path.dirname(self._model_path or "") or None
+
+    def prog_file(self) -> Optional[str]:
+        return self._model_path
+
+    # --- device selection ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # On this stack "GPU" requests map to the default accelerator (TPU).
+        self._device = None
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device != "cpu"
+
+    # --- knobs kept for parity ---
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = bool(x)
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = int(n)
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def summary(self) -> Dict[str, object]:
+        return dict(model=self._model_path, device=self._device or "default",
+                    memory_optim=self._memory_optim, ir_optim=self._ir_optim,
+                    cpu_math_threads=self._cpu_math_threads,
+                    profile=self._enable_profile)
+
+
+class PredictorTensor:
+    """Zero-copy input/output handle (reference ZeroCopyTensor /
+    `paddle_infer.Tensor`)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def reshape(self, shape):
+        # Shapes are fixed by the exported program unless the dim was
+        # exported symbolic; reshape just validates against the signature.
+        self._owner._check_shape(self.name, list(shape))
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        self._owner._set_input(self.name, np.asarray(arr))
+
+    def share_external_data(self, arr):
+        self._owner._set_input(self.name, arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._get_output(self.name))
+
+    def shape(self) -> List[int]:
+        return self._owner._handle_shape(self.name, self._is_input)
+
+    def type(self):
+        return self._owner._handle_dtype(self.name, self._is_input)
+
+
+class Predictor:
+    """Executes a jit-saved program with handle-based IO
+    (`analysis_predictor.h:105` Run path)."""
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load as jit_load
+
+        if config.prog_file() is None:
+            raise ValueError("Config has no model path")
+        self.config = config
+        self._layer = jit_load(config.prog_file())
+        n_inputs = len(self._layer._meta.get("input_avals", []))
+        self._input_names = [f"x{i}" for i in range(n_inputs)]
+        self._inputs: Dict[str, object] = {}
+        self._outputs: List[object] = []
+        self._output_names: List[str] = []
+
+    # --- reference API surface ---
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        if name not in self._input_names:
+            raise KeyError(name)
+        return PredictorTensor(name, self, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        if not self._output_names:
+            # run() populates; pre-run, derive from a dry name list
+            return [f"out{i}" for i in range(max(1, len(self._outputs)))]
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(name, self, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. With `inputs`, behaves like the reference's
+        list-in/list-out convenience; else uses handles set via
+        copy_from_cpu."""
+        from ..core.tensor import Tensor
+
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._set_input(n, np.asarray(a))
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [Tensor(self._inputs[n]) for n in self._input_names]
+        out = self._layer(*args)
+        flat = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [t._data if isinstance(t, Tensor) else t
+                         for t in flat]
+        self._output_names = [f"out{i}" for i in range(len(self._outputs))]
+        if inputs is not None:
+            return [np.asarray(o) for o in self._outputs]
+        return True
+
+    def try_shrink_memory(self):
+        self._inputs.clear()
+        self._outputs = []
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    # --- internals ---
+    def _set_input(self, name, arr):
+        self._inputs[name] = arr
+
+    def _get_output(self, name):
+        idx = self._output_names.index(name) if name in self._output_names \
+            else int(name.replace("out", "") or 0)
+        return self._outputs[idx]
+
+    def _check_shape(self, name, shape):
+        idx = self._input_names.index(name)
+        declared = self._layer._meta["input_avals"][idx][0]
+        if len(declared) != len(shape):
+            raise ValueError(
+                f"rank mismatch for {name}: program has {declared}")
+
+    def _handle_shape(self, name, is_input):
+        if is_input:
+            idx = self._input_names.index(name)
+            dims = self._layer._meta["input_avals"][idx][0]
+            return [int(d) if str(d).isdigit() else -1 for d in dims]
+        return list(np.asarray(self._get_output(name)).shape)
+
+    def _handle_dtype(self, name, is_input):
+        if is_input:
+            idx = self._input_names.index(name)
+            return self._layer._meta["input_avals"][idx][1]
+        return str(np.asarray(self._get_output(name)).dtype)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """`paddle_infer.create_predictor` (reference
+    `paddle/fluid/inference/api/analysis_predictor.cc` CreatePredictor)."""
+    return Predictor(config)

@@ -1,0 +1,199 @@
+"""Pallas TPU paged (block) KV-cache attention — the decode kernel.
+
+TPU-native equivalent of the reference's paged-attention CUDA kernel
+(`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`, python
+surface `incubate.nn.functional.block_multihead_attention`): the KV cache is a
+pool of fixed-size blocks; each sequence owns a list of block ids (its block
+table), so cache memory is allocated in O(block_size) granules instead of one
+max-seqlen slab per sequence.
+
+Kernel design (TPU-first, not a CUDA translation):
+- grid = (batch, kv_heads, max_blocks_per_seq); the block table and context
+  lengths ride scalar prefetch (SMEM) so the K/V ``BlockSpec`` index maps can
+  gather the *physical* block for each (seq, logical-block) pair — the gather
+  happens in the pipeline's DMA engine, not in the kernel body.
+- GQA is native: the q block is the whole query-head group [G, D] for one kv
+  head, so the kernel's matmuls are (G×D)·(D×BS) on the MXU with no KV
+  repetition in HBM.
+- online softmax (flash-style) accumulates across logical blocks in VMEM
+  scratch; the output is written once on the last block step.
+
+Caches use the reference layout ``[num_blocks, kv_heads, block_size, head_dim]``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import _support
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, sm_scale, block_size):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx_len = lens_ref[b]
+
+    @pl.when(j * block_size < ctx_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (G, D)
+        k = k_ref[0, 0].astype(jnp.float32)                 # (BS, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (G, BS)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx_len, s, NEG_INF)
+        m_prev = m_ref[...][:, 0]
+        l_prev = l_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _decode_call(q, k_cache, v_cache, block_tables, context_lens, sm_scale):
+    """q: [B, KV_H, G, D] (G padded); caches: [KV_H, NB, BS, D]."""
+    batch, kv_h, g, d = q.shape
+    block_size = k_cache.shape[2]
+    max_blocks = block_tables.shape[1]
+
+    kern = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                             block_size=block_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, kv_h, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda b, h, j, lens, tables: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda b, h, j, lens, tables: (h, tables[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda b, h, j, lens, tables: (h, tables[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda b, h, j, lens, tables: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, kv_h, g, d), q.dtype),
+        interpret=_support.interpret_mode(),
+    )(context_lens, block_tables, q, k_cache, v_cache)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    sm_scale=None):
+    """Decode-step paged attention over raw arrays.
+
+    Args:
+      q: [B, H, D] — one query token per sequence.
+      k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim].
+      block_tables: [B, max_blocks_per_seq] int32 physical block ids (pad 0).
+      context_lens: [B] int32 — tokens already in cache (incl. current).
+    Returns [B, H, D].
+    """
+    batch, h, d = q.shape
+    kv_h = k_cache.shape[1]
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    # [B, H, D] -> [B, KV_H, G, D], pad the group dim to the 8-row sublane
+    # tile so the MXU matmul has a full tile even for MHA (G=1).
+    qg = q.reshape(batch, kv_h, g, d)
+    g_pad = max(g, 8)
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+    kc = jnp.swapaxes(k_cache, 0, 1)  # [KV_H, NB, BS, D]
+    vc = jnp.swapaxes(v_cache, 0, 1)
+    out = _decode_call(qg, kc, vc, block_tables.astype(jnp.int32),
+                       context_lens.astype(jnp.int32), float(sm_scale))
+    return out[:, :, :g, :].reshape(batch, h, d)
+
+
+def paged_attention_ref(q, k_cache, v_cache, block_tables, context_lens,
+                        sm_scale=None):
+    """XLA reference path (gather + masked softmax); also the CPU fallback."""
+    batch, h, d = q.shape
+    nb, kv_h, bs, _ = k_cache.shape
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    # gather each sequence's blocks: [B, max_blocks, KV_H, BS, D]
+    k = jnp.take(k_cache, block_tables, axis=0)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    max_s = block_tables.shape[1] * bs
+    k = jnp.swapaxes(k, 2, 3).reshape(batch, max_s, kv_h, d)
+    v = jnp.swapaxes(v, 2, 3).reshape(batch, max_s, kv_h, d)
+    qg = q.reshape(batch, kv_h, g, d)
+    s = jnp.einsum("bhgd,bshd->bhgs", qg.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    mask = jnp.arange(max_s)[None, :] < context_lens[:, None]  # [B, S]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(batch, h, d).astype(q.dtype)
+
+
+def write_kv_to_cache(k, v, k_cache, v_cache, block_tables, start_pos):
+    """Scatter new K/V tokens into the block pool.
+
+    k/v: [B, S, KV_H, D] new tokens for positions [start_pos, start_pos+S).
+    start_pos: [B] int32 (tokens already cached per sequence).
+    Returns updated (k_cache, v_cache). Pure-XLA scatter (no kernel needed:
+    the write is bandwidth-bound and XLA lowers it to an efficient
+    dynamic-update stream).
+    """
+    batch, s, kv_h, d = k.shape
+    nb, _, bs, _ = k_cache.shape
+    pos = start_pos[:, None] + jnp.arange(s)[None, :]          # [B, S]
+    blk = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [B, S]
+    flat = blk * bs + pos % bs                                  # [B, S]
+    kc = k_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
+    vc = v_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
+    kc = kc.at[flat.reshape(-1)].set(k.reshape(-1, kv_h, d))
+    vc = vc.at[flat.reshape(-1)].set(v.reshape(-1, kv_h, d))
+    kc = kc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+    vc = vc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+    return kc, vc
+
+
+def supported(q_shape, dtype) -> bool:
+    if not _support.kernels_enabled():
+        return False
+    if len(q_shape) != 3:
+        return False
+    if q_shape[-1] > 256:
+        return False
+    return str(np.dtype(dtype)) in ("float32", "bfloat16", "float16")

@@ -1,0 +1,251 @@
+"""Inference stack (L9) tests: paged-attention kernel, decode functionals,
+Llama engine vs eager forward, Predictor over saved programs.
+
+Reference test model: `test/legacy_test/test_block_multihead_attention.py`
+(numeric parity of the paged path vs dense attention) and the predictor API
+tests under `test/ir/inference/`.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import flags
+
+
+@pytest.fixture(autouse=True)
+def _interpret_pallas():
+    flags.set_flags({"FLAGS_pallas_interpret": True})
+    yield
+    flags.set_flags({"FLAGS_pallas_interpret": False})
+
+
+def test_paged_attention_kernel_matches_ref(rng):
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    B, H, KVH, D, BS, NB, MAXB = 2, 8, 4, 32, 16, 12, 4
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(NB)[:B * MAXB].reshape(B, MAXB),
+                         jnp.int32)
+    lens = jnp.asarray([37, 50], jnp.int32)
+    ref = pa.paged_attention_ref(q, kc, vc, tables, lens)
+    out = pa.paged_attention(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_paged_attention_mha_group1(rng):
+    """MHA (G=1) exercises the group-padding path."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    B, H, D, BS, NB, MAXB = 2, 4, 16, 8, 10, 3
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, H, BS, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, H, BS, D)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, NB, size=(B, MAXB)), jnp.int32)
+    lens = jnp.asarray([9, 17], jnp.int32)
+    ref = pa.paged_attention_ref(q, kc, vc, tables, lens)
+    out = pa.paged_attention(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_write_kv_then_decode_roundtrip(rng):
+    """Prefill-write + decode attention == dense causal attention."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    B, S, KVH, H, D, BS = 2, 12, 2, 4, 16, 8
+    NB, MAXB = 8, 3
+    k = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    kc = jnp.zeros((NB, KVH, BS, D), jnp.float32)
+    vc = jnp.zeros((NB, KVH, BS, D), jnp.float32)
+    tables = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+    kc, vc = pa.write_kv_to_cache(k, v, kc, vc, tables,
+                                  jnp.zeros((B,), jnp.int32))
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    lens = jnp.full((B,), S, jnp.int32)
+    out = pa.paged_attention(q, kc, vc, tables, lens)
+    # dense reference: repeat kv heads, full softmax over S tokens
+    kr = jnp.repeat(k, H // KVH, axis=2)
+    vr = jnp.repeat(v, H // KVH, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q, kr) / np.sqrt(D)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhs,bshd->bhd", p, vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_masked_multihead_attention(rng):
+    from paddle_tpu.incubate.nn import functional as incf
+
+    B, H, D, MS = 2, 3, 8, 16
+    cached = [5, 11]
+    cache = np.zeros((2, B, H, MS, D), np.float32)
+    for b in range(B):
+        cache[:, b, :, :cached[b]] = rng.normal(
+            size=(2, H, cached[b], D))
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    out, new_cache = incf.masked_multihead_attention(
+        paddle.Tensor(x), paddle.Tensor(cache),
+        sequence_lengths=paddle.Tensor(np.asarray(cached, np.int32)))
+    out = np.asarray(out._data)
+    nc = np.asarray(new_cache._data)
+    qkv = x.reshape(B, 3, H, D)
+    for b in range(B):
+        n = cached[b] + 1
+        k = np.concatenate([cache[0, b, :, :cached[b]],
+                            qkv[b, 1][:, None]], axis=1)
+        v = np.concatenate([cache[1, b, :, :cached[b]],
+                            qkv[b, 2][:, None]], axis=1)
+        s = np.einsum("hd,hsd->hs", qkv[b, 0], k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hs,hsd->hd", p, v).reshape(H * D)
+        np.testing.assert_allclose(out[b], ref, atol=1e-4)
+        # cache write landed at position cached[b]
+        np.testing.assert_allclose(nc[0, b, :, cached[b]], qkv[b, 1],
+                                   atol=1e-6)
+
+
+def test_block_multihead_attention_prefill_then_decode(rng):
+    """Paged prefill + decode equals dense causal attention on the full
+    sequence (the reference kernel's correctness contract)."""
+    from paddle_tpu.incubate.nn import functional as incf
+
+    B, S, H, KVH, D, BS, NB, MAXB = 2, 6, 4, 2, 8, 4, 8, 3
+    width = (H + 2 * KVH) * D
+    kc = paddle.Tensor(np.zeros((NB, KVH, BS, D), np.float32))
+    vc = paddle.Tensor(np.zeros((NB, KVH, BS, D), np.float32))
+    tables = paddle.Tensor(np.asarray([[0, 1, 2], [3, 4, 5]], np.int32))
+    qkv_pre = rng.normal(size=(B * S, width)).astype(np.float32)
+    o, _, kc, vc = incf.block_multihead_attention(
+        paddle.Tensor(qkv_pre), kc, vc,
+        seq_lens_encoder=paddle.Tensor(np.full((B,), S, np.int32)),
+        seq_lens_decoder=paddle.Tensor(np.zeros((B,), np.int32)),
+        seq_lens_this_time=paddle.Tensor(np.full((B,), S, np.int32)),
+        block_tables=tables, block_size=BS)
+    qkv_dec = rng.normal(size=(B, width)).astype(np.float32)
+    o2, _, kc2, vc2 = incf.block_multihead_attention(
+        paddle.Tensor(qkv_dec), kc, vc,
+        seq_lens_encoder=paddle.Tensor(np.zeros((B,), np.int32)),
+        seq_lens_decoder=paddle.Tensor(np.full((B,), S, np.int32)),
+        seq_lens_this_time=paddle.Tensor(np.ones((B,), np.int32)),
+        block_tables=tables, block_size=BS)
+    # dense reference over the full S+1 token sequence
+    allq = np.concatenate([qkv_pre.reshape(B, S, -1, D),
+                           qkv_dec.reshape(B, 1, -1, D)], axis=1)
+    q = allq[:, :, :H]
+    k = np.repeat(allq[:, :, H:H + KVH], H // KVH, axis=2)
+    v = np.repeat(allq[:, :, H + KVH:], H // KVH, axis=2)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    mask = np.tril(np.ones((S + 1, S + 1), bool))
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(o2._data).reshape(B, H, D),
+                               ref[:, -1], atol=1e-4)
+
+
+def test_llama_engine_prefill_matches_eager():
+    """The fused scan-over-layers prefill reproduces the eager model's
+    logits — the VERDICT 'decode matches eager forward' gate."""
+    from paddle_tpu.inference import LlamaInferenceEngine
+    from paddle_tpu.models.llama import llama_tiny
+
+    paddle.seed(7)
+    model = llama_tiny(vocab=64, layers=2, hidden=32, heads=4, seq=32)
+    model.eval()
+    eng = LlamaInferenceEngine(model, max_batch_size=2, num_blocks=16,
+                               block_size=8, max_blocks_per_seq=4)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 64, size=(2, 9)).astype(np.int32)
+    for i in range(2):
+        eng.manager.allocate(i, 9)
+    tables = eng.manager.block_table_array([0, 1])
+    logits = np.asarray(eng.prefill(ids, tables))
+    eager = model(paddle.Tensor(ids))
+    ref = np.asarray(eager._data)[:, -1, :]
+    np.testing.assert_allclose(logits, ref, atol=2e-4, rtol=2e-4)
+    eng.manager.free(0)
+    eng.manager.free(1)
+
+
+def test_llama_engine_generate_matches_eager_greedy():
+    """Greedy generation with the paged cache matches token-by-token greedy
+    decoding through the eager model (full-context recompute)."""
+    from paddle_tpu.inference import GenerationConfig, LlamaInferenceEngine
+    from paddle_tpu.models.llama import llama_tiny
+
+    paddle.seed(11)
+    model = llama_tiny(vocab=48, layers=2, hidden=32, heads=4, seq=48)
+    model.eval()
+    eng = LlamaInferenceEngine(model, max_batch_size=2, num_blocks=32,
+                               block_size=8, max_blocks_per_seq=6)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 48, size=(2, 7)).astype(np.int32)
+    n_new = 6
+    out = eng.generate(ids, GenerationConfig(max_new_tokens=n_new))
+    assert out.shape == (2, 7 + n_new)
+    # eager greedy reference: recompute the full context each step
+    cur = ids.copy()
+    for _ in range(n_new):
+        logits = np.asarray(model(paddle.Tensor(cur))._data)[:, -1, :]
+        nxt = logits.argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
+    # cache pool fully returned
+    assert eng.manager.free_blocks == 32
+
+
+def test_block_cache_manager():
+    from paddle_tpu.inference import BlockCacheManager
+
+    m = BlockCacheManager(num_blocks=8, block_size=4, max_blocks_per_seq=4)
+    m.allocate(0, 5)              # needs 2 blocks
+    assert m.free_blocks == 6
+    for _ in range(3):            # 5 -> 8 tokens, still 2 blocks
+        m.append_token(0)
+    assert m.free_blocks == 6
+    m.append_token(0)             # 9th token -> 3rd block
+    assert m.free_blocks == 5
+    t = m.block_table_array([0])
+    assert t.shape == (1, 4) and len(set(t[0][:3])) == 3
+    m.free(0)
+    assert m.free_blocks == 8
+    with pytest.raises(ValueError):
+        m.allocate(1, 100)     # exceeds max_blocks_per_seq
+    m.allocate(1, 16)
+    m.allocate(2, 16)          # pool now empty
+    with pytest.raises(RuntimeError):
+        m.allocate(3, 16)      # pool exhausted
+
+
+def test_predictor_over_saved_program(tmp_path):
+    """jit.save -> Config -> create_predictor -> handles -> run."""
+    import paddle_tpu.inference as paddle_infer
+    from paddle_tpu import jit, nn
+    from paddle_tpu.jit.to_static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    path = str(tmp_path / "model")
+    jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    cfg = paddle_infer.Config(path + ".pdmodel", path + ".pdiparams")
+    predictor = paddle_infer.create_predictor(cfg)
+    names = predictor.get_input_names()
+    assert names == ["x0"]
+    h = predictor.get_input_handle("x0")
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    h.copy_from_cpu(x)
+    assert predictor.run()
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    got = out_h.copy_to_cpu()
+    ref = np.asarray(net(paddle.Tensor(x))._data)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    # convenience list API
+    got2 = predictor.run([x])[0]
+    np.testing.assert_allclose(got2, ref, atol=1e-5)
