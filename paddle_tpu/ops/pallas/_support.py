@@ -37,6 +37,36 @@ def kernels_enabled() -> bool:
     return bool(flags.flag_value("pallas_interpret"))
 
 
+def x64_off():
+    """Context manager disabling x64 around a `pallas_call` invocation.
+
+    The package enables jax_enable_x64 globally (paddle int64 semantics), but
+    Mosaic has no i64/f64: under x64, Python int literals in BlockSpec index
+    maps and float scalars in kernel bodies trace as 64-bit and fail TPU
+    lowering (infinite _convert_helper recursion / truncf legalization).
+    Kernel dtypes are all explicit, so tracing them with x64 off is exact.
+    """
+    import jax
+
+    return jax.enable_x64(False)
+
+
+def pallas_call(*args, **kwargs):
+    """`pl.pallas_call` whose returned callable traces with x64 disabled.
+
+    All kernels in this package must go through this wrapper (see x64_off).
+    """
+    from jax.experimental import pallas as pl
+
+    inner = pl.pallas_call(*args, **kwargs)
+
+    def wrapped(*operands):
+        with x64_off():
+            return inner(*operands)
+
+    return wrapped
+
+
 def pick_block(n: int, preferred: int = 128) -> int:
     """Largest power-of-two block <= preferred that divides n (0 if none >= 8)."""
     b = preferred

@@ -15,8 +15,27 @@ from jax.experimental import pallas as pl
 
 from . import _support
 
+def _erf_approx(x):
+    # Mosaic has no erf/erfc primitive; Abramowitz-Stegun 7.1.26 rational
+    # approximation (|err| < 1.5e-7, below bf16/f32-accum noise) using only
+    # exp, which Mosaic lowers natively.
+    a1, a2, a3 = 0.254829592, -0.284496736, 1.421413741
+    a4, a5, p = -1.453152027, 1.061405429, 0.3275911
+    s = jnp.sign(x)
+    ax = jnp.abs(x)
+    t = 1.0 / (1.0 + p * ax)
+    poly = t * (a1 + t * (a2 + t * (a3 + t * (a4 + t * a5))))
+    return s * (1.0 - poly * jnp.exp(-ax * ax))
+
+
+def _gelu_erf(x):
+    # jax.nn.gelu(approximate=False) lowers via erfc, which Mosaic cannot
+    # compile; the erf formulation is mathematically identical.
+    return x * 0.5 * (1.0 + _erf_approx(x * jnp.float32(0.7071067811865476)))
+
+
 _ACTS = {
-    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu": _gelu_erf,
     "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "relu": lambda x: jnp.maximum(x, 0),
     "silu": jax.nn.silu,
@@ -50,7 +69,7 @@ def _pallas_bias_act(x2d, bias, act_method):
     r, hdim = x2d.shape
     br = _support.pick_block(r, 256) or r
     out_h = hdim // 2 if act_method in ("swiglu", "geglu") else hdim
-    return pl.pallas_call(
+    return _support.pallas_call(
         functools.partial(_kernel, act_method=act_method),
         grid=(pl.cdiv(r, br),),
         in_specs=[
@@ -100,7 +119,7 @@ def _kernel2(x_ref, y_ref, o_ref):
 def _pallas_swiglu2(x2d, y2d):
     r, hdim = x2d.shape
     br = _support.pick_block(r, 256) or r
-    return pl.pallas_call(
+    return _support.pallas_call(
         _kernel2,
         grid=(pl.cdiv(r, br),),
         in_specs=[

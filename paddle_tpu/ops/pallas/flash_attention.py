@@ -26,11 +26,12 @@ NEG_INF = -1e30
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 block_q, block_k, seq_k):
     i = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, d)
+    q = q_ref[0, 0].astype(jnp.float32) * jnp.float32(sm_scale)  # (bq, d)
     d = q.shape[-1]
-    nkb = seq_k // block_k
+    # i32 bounds: Python ints trace as i64 under x64 and Mosaic has no i64
+    nkb = jnp.int32(seq_k // block_k)
     if causal:
-        hi = jnp.minimum(((i + 1) * block_q + block_k - 1) // block_k, nkb)
+        hi = jnp.minimum(((i + 1) * block_q + block_k - 1) // jnp.int32(block_k), nkb)
     else:
         hi = nkb
 
@@ -49,7 +50,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -58,8 +59,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
+    acc, m, l = jax.lax.fori_loop(jnp.int32(0), hi, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
 
@@ -72,29 +73,31 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     lse = lse_ref[0, 0, :, 0]
     delta = delta_ref[0, 0, :, 0]
     d = q.shape[-1]
-    nkb = seq_k // block_k
-    hi = (jnp.minimum(((i + 1) * block_q + block_k - 1) // block_k, nkb)
+    nkb = jnp.int32(seq_k // block_k)
+    hi = (jnp.minimum(((i + 1) * block_q + block_k - 1) // jnp.int32(block_k), nkb)
           if causal else nkb)
 
     def body(j, dq):
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = sm_scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                           preferred_element_type=jnp.float32)
+        s = jnp.float32(sm_scale) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + sm_scale * jax.lax.dot_general(
+        return dq + jnp.float32(sm_scale) * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq = jax.lax.fori_loop(jnp.int32(0), hi, body,
+                           jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
@@ -104,8 +107,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     k = k_ref[0, 0].astype(jnp.float32)                     # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
     d = k.shape[-1]
-    nqb = seq_q // block_q
-    lo = (j * block_k) // block_q if causal else 0
+    nqb = jnp.int32(seq_q // block_q)
+    lo = (j * block_k) // jnp.int32(block_q) if causal else jnp.int32(0)
 
     def body(i, carry):
         dk, dv = carry
@@ -113,21 +116,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
-        s = sm_scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                           preferred_element_type=jnp.float32)
+        s = jnp.float32(sm_scale) * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                       # (bq, bk)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk_new = dk + sm_scale * jax.lax.dot_general(
+        dk_new = dk + jnp.float32(sm_scale) * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -151,7 +155,7 @@ def _fa_forward(q, k, v, causal, sm_scale):
     interp = _support.interpret_mode()
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              block_q=bq, block_k=bk, seq_k=sk)
-    out, lse = pl.pallas_call(
+    out, lse = _support.pallas_call(
         kern,
         grid=(b, h, sq // bq),
         in_specs=[
@@ -196,7 +200,7 @@ def _flash_bwd_rule(causal, sm_scale, res, g):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    dq = pl.pallas_call(
+    dq = _support.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, seq_k=sk),
         grid=(b, h, sq // bq),
@@ -213,7 +217,7 @@ def _flash_bwd_rule(causal, sm_scale, res, g):
         interpret=interp,
     )(q, k, v, g, lse, delta)
 
-    dk, dv = pl.pallas_call(
+    dk, dv = _support.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, seq_q=sq),
         grid=(b, h, sk // bk),

@@ -104,7 +104,7 @@ def _decode_call(q, k_cache, v_cache, block_tables, context_lens, sm_scale):
             pltpu.VMEM((g, 1), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    return _support.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((batch, kv_h, g, d), q.dtype),

@@ -24,7 +24,7 @@ def _rms_fwd_kernel(x_ref, w_ref, y_ref, *, eps):
 def _pallas_fwd(x2d, w, eps):
     r, hdim = x2d.shape
     br = _support.pick_block(r, 256) or r
-    return pl.pallas_call(
+    return _support.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=eps),
         grid=(pl.cdiv(r, br),),
         in_specs=[

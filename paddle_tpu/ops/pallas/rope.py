@@ -33,7 +33,7 @@ def _rope_kernel(q_ref, k_ref, c_ref, s_ref, oq_ref, ok_ref, *, conj):
 def _pallas_rope(q, k, cos, sin, conj):
     b, s, h, d = q.shape
     bs = _support.pick_block(s) or s
-    return pl.pallas_call(
+    return _support.pallas_call(
         functools.partial(_rope_kernel, conj=conj),
         grid=(b, s // bs),
         in_specs=[
