@@ -123,10 +123,8 @@ class LlamaAttention(nn.Layer):
                 k = M.concat([pk, k], axis=1)
                 v = M.concat([pv, v], axis=1)
             new_cache = (k, v)
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = M.repeat_interleave(k, rep, axis=2)
-            v = M.repeat_interleave(v, rep, axis=2)
+        # GQA K/V stay un-repeated: the Pallas flash path groups natively;
+        # the sdpa fallback expands inside _sdpa_fn.
         causal = kv_cache is None or q.shape[1] > 1
         out = F.scaled_dot_product_attention(q, k, v, is_causal=causal)
         out = M.reshape(out, [b, s, self.num_heads * self.head_dim])

@@ -49,6 +49,10 @@ def _sdpa_fn(q, k, v, mask, causal, scale, is_bnsd):
         q = jnp.swapaxes(q, 1, 2)  # -> [B, H, S, D]
         k = jnp.swapaxes(k, 1, 2)
         v = jnp.swapaxes(v, 1, 2)
+    if k.shape[1] != q.shape[1]:   # GQA fallback: expand grouped KV heads
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)

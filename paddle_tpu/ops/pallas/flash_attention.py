@@ -8,6 +8,17 @@ kernels is [B, H, S, D] (MXU-friendly: S×D tiles); K/V live in VMEM per
 (batch, head) which bounds supported seqlen at ~16k for D=128 bf16 — beyond
 that the ring-attention path (`paddle_tpu.distributed.ring_attention`) shards
 the sequence over the mesh instead.
+
+Native GQA: K/V carry their own (smaller) head count; the BlockSpec index
+maps route query head h to kv head h // group, so grouped K/V are never
+repeated in HBM (the reference repeats via `flash_attn_utils.h` head
+expansion). Backward accumulates dK/dV per query head and group-sums outside
+the kernel.
+
+Varlen/padding: an optional per-sequence `kv_lens` [B] rides SMEM; the
+kernels bound their K-block loop at cdiv(len, block_k) and mask the tail
+block, so right-padded batches skip padded compute entirely (the role of the
+reference's cu_seqlens varlen path for padded serving batches).
 """
 from __future__ import annotations
 
@@ -17,23 +28,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from . import _support
 
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_q, block_k, seq_k):
+def _kv_hi(causal_hi, lens_ref, b, block_k, use_lens):
+    if not use_lens:
+        return causal_hi
+    kvl = lens_ref[b]
+    return jnp.minimum(causal_hi,
+                       (kvl + block_k - 1) // jnp.int32(block_k))
+
+
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, seq_k, use_lens):
+    if use_lens:
+        lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        lens_ref = None
+    b = pl.program_id(0)
     i = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * jnp.float32(sm_scale)  # (bq, d)
     d = q.shape[-1]
     # i32 bounds: Python ints trace as i64 under x64 and Mosaic has no i64
     nkb = jnp.int32(seq_k // block_k)
     if causal:
-        hi = jnp.minimum(((i + 1) * block_q + block_k - 1) // jnp.int32(block_k), nkb)
+        hi = jnp.minimum(
+            ((i + 1) * block_q + block_k - 1) // jnp.int32(block_k), nkb)
     else:
         hi = nkb
+    hi = _kv_hi(hi, lens_ref, b, block_k, use_lens)
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -45,12 +72,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
+        if use_lens:
+            s = jnp.where(cols < lens_ref[b], s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -65,8 +94,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0, 0] = (m + jnp.log(l_safe))[:, None]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               sm_scale, causal, block_q, block_k, seq_k):
+def _dq_kernel(*refs, sm_scale, causal, block_q, block_k, seq_k, use_lens):
+    if use_lens:
+        lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        lens_ref = None
+    b = pl.program_id(0)
     i = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -74,8 +108,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0, 0, :, 0]
     d = q.shape[-1]
     nkb = jnp.int32(seq_k // block_k)
-    hi = (jnp.minimum(((i + 1) * block_q + block_k - 1) // jnp.int32(block_k), nkb)
+    hi = (jnp.minimum(((i + 1) * block_q + block_k - 1) // jnp.int32(block_k),
+                      nkb)
           if causal else nkb)
+    hi = _kv_hi(hi, lens_ref, b, block_k, use_lens)
 
     def body(j, dq):
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -83,13 +119,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = jnp.float32(sm_scale) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
+        if use_lens:
+            s = jnp.where(cols < lens_ref[b], s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])
+        if use_lens:
+            # fully-masked rows have lse == NEG_INF, so exp(s - lse) = 1
+            # instead of 0 on masked columns; zero them explicitly
+            p = jnp.where(cols < lens_ref[b], p, jnp.float32(0.0))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -101,8 +143,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, *, sm_scale, causal, block_q, block_k, seq_q):
+def _dkv_kernel(*refs, sm_scale, causal, block_q, block_k, seq_q, use_lens):
+    if use_lens:
+        (lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+        lens_ref = None
+    b = pl.program_id(0)
     j = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)                     # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -119,13 +168,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         s = jnp.float32(sm_scale) * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
+        if use_lens:
+            s = jnp.where(cols < lens_ref[b], s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        if use_lens:
+            # see _dq_kernel: zero p where lse itself is NEG_INF
+            p = jnp.where(cols < lens_ref[b], p, jnp.float32(0.0))
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -148,21 +202,39 @@ def _blocks(seq_q, seq_k):
     return bq, bk
 
 
-def _fa_forward(q, k, v, causal, sm_scale):
+def _lens_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _prep_lens(kv_lens):
+    if kv_lens is None:
+        return None, False
+    return kv_lens.astype(jnp.int32), True
+
+
+def _fa_forward(q, k, v, causal, sm_scale, kv_lens=None):
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
     bq, bk = _blocks(sq, sk)
     interp = _support.interpret_mode()
+    lens, use_lens = _prep_lens(kv_lens)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                             block_q=bq, block_k=bk, seq_k=sk)
+                             block_q=bq, block_k=bk, seq_k=sk,
+                             use_lens=use_lens)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+    ]
+    args = [q, k, v]
+    if use_lens:
+        in_specs = [_lens_spec()] + in_specs
+        args = [lens] + args
     out, lse = _support.pallas_call(
         kern,
         grid=(b, h, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -176,80 +248,103 @@ def _fa_forward(q, k, v, causal, sm_scale):
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
             transcendentals=b * h * sq * sk),
         interpret=interp,
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhsd(q, k, v, causal, sm_scale):
-    out, _ = _fa_forward(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_bhsd(q, k, v, kv_lens, causal, sm_scale):
+    out, _ = _fa_forward(q, k, v, causal, sm_scale, kv_lens)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale):
-    out, lse = _fa_forward(q, k, v, causal, sm_scale)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, kv_lens, causal, sm_scale):
+    out, lse = _fa_forward(q, k, v, causal, sm_scale, kv_lens)
+    return out, (q, k, v, kv_lens, out, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, res, g):
-    q, k, v, out, lse = res
+    q, k, v, kv_lens, out, lse = res
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
     bq, bk = _blocks(sq, sk)
     interp = _support.interpret_mode()
+    lens, use_lens = _prep_lens(kv_lens)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+    ]
+    dq_args = [q, k, v, g, lse, delta]
+    if use_lens:
+        dq_specs = [_lens_spec()] + dq_specs
+        dq_args = [lens] + dq_args
     dq = _support.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_k=sk),
+                          block_q=bq, block_k=bk, seq_k=sk,
+                          use_lens=use_lens),
         grid=(b, h, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interp,
-    )(q, k, v, g, lse, delta)
+    )(*dq_args)
 
+    # dK/dV are accumulated per QUERY head (grid dim 1 = h) and group-summed
+    # below — keeps the kernel race-free without materialising repeated K/V.
+    dkv_specs = [
+        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_ // group, j, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_ // group, j, 0)),
+        pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
+    ]
+    dkv_args = [q, k, v, g, lse, delta]
+    if use_lens:
+        dkv_specs = [_lens_spec()] + dkv_specs
+        dkv_args = [lens] + dkv_args
     dk, dv = _support.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_q=sq),
+                          block_q=bq, block_k=bk, seq_q=sq,
+                          use_lens=use_lens),
         grid=(b, h, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
-            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
         ],
         interpret=interp,
-    )(q, k, v, g, lse, delta)
-    return dq, dk, dv
+    )(*dkv_args)
+    if group > 1:
+        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv, None
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None):
-    """Raw-array flash attention in [B, H, S, D] layout."""
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, kv_lens=None):
+    """Raw-array flash attention in [B, H, S, D] layout.
+
+    GQA-native: k/v may have fewer heads (h % hk == 0). kv_lens [B] masks
+    key positions >= kv_lens[b] (right-padded batches).
+    """
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    return _flash_bhsd(q, k, v, bool(causal), float(sm_scale))
+    return _flash_bhsd(q, k, v, kv_lens, bool(causal), float(sm_scale))
 
 
 def _flash_bshd(q, k, v, causal):
@@ -273,7 +368,8 @@ def supported(q_shape, k_shape, dtype) -> bool:
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
-    if h != k_shape[2]:  # GQA: caller must repeat kv heads first
+    hk = k_shape[2]
+    if hk == 0 or h % hk != 0:   # GQA: query heads must group evenly
         return False
     if d > 256:
         return False
