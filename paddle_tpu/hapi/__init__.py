@@ -1,0 +1,8 @@
+"""paddle_tpu.hapi — high-level Model API (reference `python/paddle/hapi/`)."""
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler, ModelCheckpoint,
+                        ProgBarLogger)
+from .model import Model, summary
+
+__all__ = ["Model", "summary", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping"]

@@ -1,0 +1,331 @@
+"""hapi `paddle.Model` — the high-level train/eval/predict API.
+
+Parity target: `python/paddle/hapi/model.py:1082` (`Model`, fit `:1808`,
+`DynamicGraphAdapter.train_batch:847`) and `paddle.summary`
+(`hapi/model_summary.py`). The reference switches between a dygraph adapter
+and a static-graph adapter; here eager mode IS jit-backed (per-op executable
+cache), so one adapter suffices — `Model.prepare/fit/evaluate/predict` drive
+the same Layer/optimizer/DataLoader machinery either way.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+def _metric_name(m):
+    n = m.name()
+    return n[0] if isinstance(n, (list, tuple)) else n
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _as_tensors(batch):
+    out = []
+    for b in _to_list(batch):
+        if isinstance(b, Tensor):
+            out.append(b)
+        else:
+            out.append(Tensor(np.asarray(b)))
+    return out
+
+
+class Model:
+    """An object trained/evaluated with high-level APIs (reference
+    `hapi/model.py:1082`)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._optimizer = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """Configure optimizer/loss/metrics (reference model.py:1722)."""
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, Layer) \
+                and not callable(loss):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        if self._loss is None:
+            return outs[0]
+        return self._loss(*(outs + labels))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimization step (reference DynamicGraphAdapter:847)."""
+        self.network.train()
+        ins = _as_tensors(inputs)
+        lbs = _as_tensors(labels)
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, lbs)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(*(_to_list(outputs) + lbs))
+            m.update(*[np.asarray(r._data if isinstance(r, Tensor) else r)
+                       for r in _to_list(res)])
+            metrics.append(m.accumulate())
+        out = [float(np.asarray(loss._data))]
+        return (out, metrics) if metrics else out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core import autograd
+
+        with autograd.no_grad():
+            ins = _as_tensors(inputs)
+            lbs = _as_tensors(labels)
+            outputs = self.network(*ins)
+            losses = None
+            if self._loss is not None and lbs:
+                losses = [float(np.asarray(
+                    self._compute_loss(outputs, lbs)._data))]
+            metrics = []
+            for m in self._metrics:
+                res = m.compute(*(_to_list(outputs) + lbs))
+                m.update(*[np.asarray(r._data if isinstance(r, Tensor) else r)
+                           for r in _to_list(res)])
+                metrics.append(m.accumulate())
+        if losses is not None and metrics:
+            return losses, metrics
+        return losses if losses is not None else metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core import autograd
+
+        with autograd.no_grad():
+            outputs = self.network(*_as_tensors(inputs))
+        return [np.asarray(o._data) for o in _to_list(outputs)]
+
+    # ------------------------------------------------------------------
+    def _split_batch(self, data, for_predict=False):
+        """DataLoader yields [x...] or [x..., y...]; split by declared
+        inputs/labels, defaulting to last-element-is-label when a loss is
+        configured."""
+        data = _to_list(data)
+        if self._inputs is not None:
+            n_in = len(_to_list(self._inputs))
+        elif len(data) > 1 and (for_predict or self._loss is not None
+                                or self._metrics):
+            # labeled dataset: trailing element(s) are labels even when no
+            # loss is configured (predict on a (x, y) dataset must not feed
+            # y into the network); declare `inputs` for multi-input nets
+            n_in = len(data) - (len(_to_list(self._labels))
+                                if self._labels is not None else 1)
+        else:
+            n_in = len(data)
+        return data[:n_in], data[n_in:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """Training loop (reference model.py:1808)."""
+        from .. import io
+
+        if isinstance(train_data, io.DataLoader):
+            loader = train_data
+        else:
+            loader = io.DataLoader(train_data, batch_size=batch_size,
+                                   shuffle=shuffle, drop_last=drop_last,
+                                   num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=[_metric_name(m) for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin({})
+        it = 0
+        pending_grads = False
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            for step, data in enumerate(loader):
+                cbks.on_train_batch_begin(step, {})
+                ins, lbs = self._split_batch(data)
+                # accumulation counts across epochs (global iteration), so a
+                # partial window never silently leaks into the next epoch
+                update = (it + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, lbs, update=update)
+                pending_grads = not update
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters and it >= num_iters:
+                    self.stop_training = True
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, callbacks=callbacks,
+                              num_workers=num_workers)
+            if self.stop_training:
+                break
+        if pending_grads and self._optimizer is not None:
+            # apply the trailing partial accumulation window
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from .. import io
+
+        loader = eval_data if isinstance(eval_data, io.DataLoader) else \
+            io.DataLoader(eval_data, batch_size=batch_size, shuffle=False,
+                          num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                log_freq=log_freq,
+                                metrics=[_metric_name(m) for m in self._metrics])
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({})
+        logs = {}
+        for step, data in enumerate(loader):
+            cbks.on_eval_batch_begin(step, {})
+            ins, lbs = self._split_batch(data)
+            res = self.eval_batch(ins, lbs)
+            logs = self._pack_logs(res, prefix="eval_")
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from .. import io
+
+        loader = test_data if isinstance(test_data, io.DataLoader) else \
+            io.DataLoader(test_data, batch_size=batch_size, shuffle=False,
+                          num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose)
+        cbks.on_predict_begin({})
+        outputs = []
+        for step, data in enumerate(loader):
+            cbks.on_predict_batch_begin(step, {})
+            ins, _ = self._split_batch(data, for_predict=True)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        # transpose [steps][n_out] -> [n_out][steps]
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    def _pack_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs[prefix + "loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                logs[prefix + _metric_name(m)] = v
+        elif res is not None:
+            # a bare list is a loss unless no loss fn is configured, in
+            # which case eval/train returned only metric accumulates
+            if self._loss is None and self._metrics:
+                for m, v in zip(self._metrics, res):
+                    logs[prefix + _metric_name(m)] = v
+            else:
+                logs[prefix + "loss"] = res
+        return logs
+
+    # ------------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        """reference model.py:1402 — training=True saves params+opt state;
+        False exports an inference program via jit.save."""
+        if not training:
+            from .. import jit
+
+            spec = _to_list(self._inputs) or None
+            jit.save(self.network, path, input_spec=spec)
+            return
+        from ..framework.io import save as fsave
+
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        from ..framework.io import load as fload
+
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """`paddle.summary` (reference `hapi/model_summary.py`): layer table +
+    param counts. Returns {'total_params': N, 'trainable_params': M}."""
+    rows = []
+    total, trainable = 0, 0
+    for name, layer in net.named_sublayers(include_self=True):
+        own = [p for p in layer.parameters(include_sublayers=False)]
+        n = sum(int(np.prod(p.shape)) for p in own)
+        if own:
+            rows.append((name or layer.__class__.__name__,
+                         layer.__class__.__name__, n))
+        total += n
+        trainable += sum(int(np.prod(p.shape)) for p in own
+                         if not p.stop_gradient)
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    lines = ["-" * (width + 30),
+             f"{'Layer':<{width}}{'Type':<20}{'Params':>10}",
+             "=" * (width + 30)]
+    for r in rows:
+        lines.append(f"{r[0]:<{width}}{r[1]:<20}{r[2]:>10,}")
+    lines.append("=" * (width + 30))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,168 @@
+"""hapi Model tests (reference `test/legacy_test/test_model.py` pattern):
+fit converges on a separable toy problem, evaluate/predict loops, metric
+integration, checkpointing, callbacks, summary."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import hapi, io, metric, nn, optimizer
+
+
+class XorDataset(io.Dataset):
+    """Linearly separable 2-class blob data."""
+
+    def __init__(self, n=256, seed=0):
+        rng = np.random.default_rng(seed)
+        self.y = rng.integers(0, 2, size=n).astype("int64")
+        centers = np.asarray([[-1.5, -1.5], [1.5, 1.5]], np.float32)
+        self.x = (centers[self.y] +
+                  rng.normal(size=(n, 2)).astype("float32") * 0.4)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.y)
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = hapi.Model(net)
+    m.prepare(optimizer=optimizer.Adam(learning_rate=0.05,
+                                       parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss(),
+              metrics=metric.Accuracy())
+    return m
+
+
+def test_fit_converges_and_evaluate():
+    m = _model()
+    ds = XorDataset(256)
+    m.fit(ds, batch_size=32, epochs=4, verbose=0)
+    logs = m.evaluate(XorDataset(128, seed=1), batch_size=64, verbose=0)
+    assert logs["eval_acc"] > 0.95
+    assert logs["eval_loss"][0] < 0.3
+
+
+def test_predict_stacked():
+    m = _model()
+    ds = XorDataset(64)
+    m.fit(ds, batch_size=32, epochs=2, verbose=0)
+    out = m.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert len(out) == 1 and out[0].shape == (64, 2)
+    acc = (out[0].argmax(-1) == ds.y).mean()
+    assert acc > 0.9
+
+
+def test_train_eval_batch_api():
+    m = _model()
+    ds = XorDataset(32)
+    loss, met = m.train_batch([ds.x], [ds.y])
+    assert isinstance(loss[0], float) and 0 <= met[0] <= 1
+    res = m.eval_batch([ds.x], [ds.y])
+    assert isinstance(res, tuple)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = _model()
+    ds = XorDataset(64)
+    m.fit(ds, batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt")
+    m.save(path)
+    m2 = _model()
+    m2.load(path)
+    a = m.predict_batch([ds.x])[0]
+    b = m2.predict_batch([ds.x])[0]
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_save_inference_and_predictor(tmp_path):
+    import paddle_tpu.inference as paddle_infer
+    from paddle_tpu.jit.to_static import InputSpec
+
+    m = _model()
+    m._inputs = [InputSpec([4, 2], "float32")]
+    path = str(tmp_path / "infer")
+    m.save(path, training=False)
+    cfg = paddle_infer.Config(path + ".pdmodel")
+    pred = paddle_infer.create_predictor(cfg)
+    x = XorDataset(4).x
+    out = pred.run([x])[0]
+    ref = m.predict_batch([x])[0]
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_callbacks_early_stopping_and_checkpoint(tmp_path):
+    import os
+
+    m = _model()
+    ds = XorDataset(64)
+    es = hapi.EarlyStopping(monitor="eval_acc", mode="max", patience=0,
+                            verbose=0)
+    m.fit(ds, eval_data=XorDataset(32, seed=2), batch_size=32, epochs=6,
+          verbose=0, save_dir=str(tmp_path), callbacks=[es])
+    # checkpoints written per epoch + final
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 2))
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    opt = optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    ds = XorDataset(8)
+    m.fit(ds, batch_size=4, epochs=1, verbose=0)  # 2 steps -> one decay
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+def test_summary_counts():
+    net = nn.Sequential(nn.Linear(2, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = hapi.summary(net)
+    assert info["total_params"] == 2 * 16 + 16 + 16 * 2 + 2
+    assert info["trainable_params"] == info["total_params"]
+    # re-exported at package root
+    assert paddle.Model is hapi.Model
+    assert paddle.summary is hapi.summary
+
+
+def test_num_iters_stops_globally():
+    m = _model()
+    calls = []
+    orig = m.train_batch
+    m.train_batch = lambda *a, **k: (calls.append(1) or orig(*a, **k))
+    m.fit(XorDataset(64), batch_size=16, epochs=10, num_iters=5, verbose=0)
+    assert len(calls) == 5
+
+
+def test_metrics_only_eval_logs():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 2))
+    m = hapi.Model(net)
+    m.prepare(metrics=metric.Accuracy())
+    logs = m.evaluate(XorDataset(32), batch_size=16, verbose=0)
+    assert "eval_acc" in logs and "eval_loss" not in logs
+
+
+def test_predict_without_loss_splits_labels():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 2))
+    m = hapi.Model(net)
+    m.prepare()  # no loss, no metrics
+    out = m.predict(XorDataset(16), batch_size=8, stack_outputs=True,
+                    verbose=0)
+    assert out[0].shape == (16, 2)
+
+
+def test_early_stopping_saves_best_model(tmp_path):
+    import os
+
+    m = _model()
+    es = hapi.EarlyStopping(monitor="eval_acc", mode="max", patience=1,
+                            verbose=0, save_best_model=True)
+    m.fit(XorDataset(64), eval_data=XorDataset(32, seed=2), batch_size=32,
+          epochs=3, verbose=0, save_dir=str(tmp_path), callbacks=[es])
+    assert os.path.exists(str(tmp_path / "best_model.pdparams"))
