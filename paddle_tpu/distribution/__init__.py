@@ -1,0 +1,25 @@
+"""paddle_tpu.distribution — probability distributions
+(reference `python/paddle/distribution/`, ~25 classes + kl + transforms)."""
+from .continuous import (Beta, Cauchy, Chi2, Dirichlet, Exponential, Gamma,
+                         Gumbel, Laplace, LogNormal, Normal, StudentT,
+                         Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       Multinomial, Poisson)
+from .distribution import Distribution
+from .kl import kl_divergence, register_kl
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, Independent, PowerTransform,
+                        SigmoidTransform, SoftmaxTransform,
+                        StickBreakingTransform, TanhTransform, Transform,
+                        TransformedDistribution)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Beta", "Gamma", "Chi2",
+    "Dirichlet", "Exponential", "Laplace", "LogNormal", "Gumbel", "Cauchy",
+    "StudentT", "Bernoulli", "Categorical", "Multinomial", "Binomial",
+    "Poisson", "Geometric", "kl_divergence", "register_kl", "Transform",
+    "AffineTransform", "ExpTransform", "PowerTransform", "AbsTransform",
+    "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "ChainTransform", "TransformedDistribution",
+    "Independent",
+]

@@ -1,0 +1,549 @@
+"""Continuous distributions (reference `python/paddle/distribution/*.py`:
+normal, uniform, beta, gamma, dirichlet, exponential, laplace, lognormal,
+gumbel, cauchy, student_t, chi2).
+
+All math is f32/f64 jnp with reparameterized sampling where the reference
+has it (normal/uniform/laplace/gumbel/cauchy affine transforms; gamma via
+jax.random.gamma's implicit-differentiation path).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _arr, _t
+
+__all__ = ["Normal", "Uniform", "Beta", "Gamma", "Dirichlet", "Exponential",
+           "Laplace", "LogNormal", "Gumbel", "Cauchy", "StudentT", "Chi2"]
+
+
+def _bshape(*xs):
+    import jax.numpy as jnp
+
+    return jnp.broadcast_shapes(*[jnp.shape(x) for x in xs])
+
+
+class Normal(Distribution):
+    """N(loc, scale) — reference `distribution/normal.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        eps = jax.random.normal(
+            self._key(key), shp,
+            dtype=np.result_type(self.loc, self.scale, 0.1))
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        h = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(h, self.batch_shape))
+
+    def cdf(self, value):
+        import jax
+
+        v = _arr(value)
+        return Tensor(jax.scipy.stats.norm.cdf(v, self.loc, self.scale))
+
+
+class Uniform(Distribution):
+    """U(low, high) — reference `distribution/uniform.py`."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(batch_shape=_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(key), shp,
+                               dtype=np.result_type(self.low, 0.1))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        c = (v - self.low) / (self.high - self.low)
+        return Tensor(jnp.clip(c, 0.0, 1.0))
+
+
+class Beta(Distribution):
+    """Beta(alpha, beta) — reference `distribution/beta.py`."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(batch_shape=_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        k1, k2 = jax.random.split(self._key(key))
+        dt = np.result_type(self.alpha, 0.1)
+        ga = jax.random.gamma(k1, jax.numpy.broadcast_to(self.alpha, shp),
+                              dtype=dt)
+        gb = jax.random.gamma(k2, jax.numpy.broadcast_to(self.beta, shp),
+                              dtype=dt)
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - sp.betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        import jax.scipy.special as sp
+
+        a, b = self.alpha, self.beta
+        return Tensor(sp.betaln(a, b) - (a - 1) * sp.digamma(a)
+                      - (b - 1) * sp.digamma(b)
+                      + (a + b - 2) * sp.digamma(a + b))
+
+
+class Gamma(Distribution):
+    """Gamma(concentration, rate) — reference `distribution/gamma.py`."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(
+            batch_shape=_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=(), key=None):
+        import jax
+        import jax.numpy as jnp
+
+        shp = self._extend_shape(shape)
+        dt = np.result_type(self.concentration, 0.1)
+        g = jax.random.gamma(self._key(key),
+                             jnp.broadcast_to(self.concentration, shp),
+                             dtype=dt)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        a, r = self.concentration, self.rate
+        return Tensor(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                      - sp.gammaln(a))
+
+    def entropy(self):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        a, r = self.concentration, self.rate
+        return Tensor(a - jnp.log(r) + sp.gammaln(a)
+                      + (1 - a) * sp.digamma(a))
+
+
+class Chi2(Gamma):
+    """Chi2(df) = Gamma(df/2, 1/2) — reference `distribution/chi2.py`."""
+
+    def __init__(self, df):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2.0, _arr(0.5))
+
+
+class Dirichlet(Distribution):
+    """Dirichlet(concentration) — reference `distribution/dirichlet.py`."""
+
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(
+            batch_shape=tuple(self.concentration.shape[:-1]),
+            event_shape=tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(-1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+        import jax.numpy as jnp
+
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        dt = np.result_type(self.concentration, 0.1)
+        g = jax.random.gamma(self._key(key),
+                             jnp.broadcast_to(self.concentration, shp),
+                             dtype=dt)
+        return Tensor(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        a = self.concentration
+        norm = sp.gammaln(a.sum(-1)) - sp.gammaln(a).sum(-1)
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) + norm)
+
+    def entropy(self):
+        import jax.scipy.special as sp
+
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        return Tensor(sp.gammaln(a).sum(-1) - sp.gammaln(a0)
+                      + (a0 - k) * sp.digamma(a0)
+                      - ((a - 1) * sp.digamma(a)).sum(-1))
+
+
+class Exponential(Distribution):
+    """Exp(rate) — reference `distribution/exponential.py`."""
+
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(batch_shape=_bshape(self.rate))
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        e = jax.random.exponential(self._key(key), shp,
+                                   dtype=np.result_type(self.rate, 0.1))
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        return Tensor(-jnp.expm1(-self.rate * _arr(value)))
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale) — reference `distribution/laplace.py`."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2)
+
+    @property
+    def stddev(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.sqrt(2.0) * self.scale)
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(key), shp,
+                               dtype=np.result_type(self.loc, 0.1),
+                               minval=-0.5, maxval=0.5)
+        import jax.numpy as jnp
+
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(1 + jnp.log(2 * self.scale)
+                      + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+
+class LogNormal(Distribution):
+    """LogNormal(loc, scale) — reference `distribution/lognormal.py`."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        s2 = self.scale ** 2
+        return Tensor(jnp.expm1(s2) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=(), key=None):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.exp(_arr(self._normal.rsample(shape, key=key))))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor(_arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(_arr(self._normal.entropy()) + self.loc)
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale) — reference `distribution/gumbel.py`."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        g = jax.random.gumbel(self._key(key), shp,
+                              dtype=np.result_type(self.loc, 0.1))
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.log(self.scale) + 1 + self._EULER
+                      + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) — reference `distribution/cauchy.py`."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(batch_shape=_bshape(self.loc, self.scale))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        c = jax.random.cauchy(self._key(key), shp,
+                              dtype=np.result_type(self.loc, 0.1))
+        return Tensor(self.loc + self.scale * c)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale) — reference `distribution/student_t.py`."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(batch_shape=_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.where(self.df > 1,
+                                jnp.broadcast_to(self.loc, self.batch_shape),
+                                jnp.nan))
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        var = self.scale ** 2 * self.df / (self.df - 2)
+        return Tensor(jnp.where(self.df > 2, var, jnp.nan))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        t = jax.random.t(self._key(key),
+                         jax.numpy.broadcast_to(self.df, shp),
+                         dtype=np.result_type(self.loc, 0.1))
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        z = (_arr(value) - self.loc) / self.scale
+        n = self.df
+        lp = (sp.gammaln((n + 1) / 2) - sp.gammaln(n / 2)
+              - 0.5 * jnp.log(n * math.pi) - jnp.log(self.scale)
+              - (n + 1) / 2 * jnp.log1p(z * z / n))
+        return Tensor(lp)
+
+    def entropy(self):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        n = self.df
+        h = ((n + 1) / 2 * (sp.digamma((n + 1) / 2) - sp.digamma(n / 2))
+             + 0.5 * jnp.log(n) + sp.betaln(n / 2, 0.5)
+             + jnp.log(self.scale))
+        return Tensor(jnp.broadcast_to(h, self.batch_shape))

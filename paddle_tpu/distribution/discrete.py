@@ -1,0 +1,316 @@
+"""Discrete distributions (reference `python/paddle/distribution/*.py`:
+bernoulli, categorical, multinomial, binomial, poisson, geometric)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _arr
+
+__all__ = ["Bernoulli", "Categorical", "Multinomial", "Binomial", "Poisson",
+           "Geometric"]
+
+
+def _probs_logits(probs, logits):
+    import jax
+    import jax.numpy as jnp
+
+    if (probs is None) == (logits is None):
+        raise ValueError("pass exactly one of probs/logits")
+    if probs is not None:
+        p = _arr(probs)
+        return p, jnp.log(p) - jnp.log1p(-p)
+    lg = _arr(logits)
+    return jax.nn.sigmoid(lg), lg
+
+
+class Bernoulli(Distribution):
+    """Bernoulli(probs) — reference `distribution/bernoulli.py`."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        self.probs, self.logits = _probs_logits(probs, logits)
+        super().__init__(batch_shape=tuple(np.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        u = jax.random.bernoulli(self._key(key), self.probs, shp)
+        return Tensor(u.astype(np.result_type(self.probs)))
+
+    def rsample(self, shape=(), key=None, temperature=1.0):
+        """Gumbel-softmax relaxed sample (reference bernoulli rsample)."""
+        import jax
+        import jax.numpy as jnp
+
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(
+            self._key(key), shp, dtype=np.result_type(self.probs, 0.1),
+            minval=1e-6, maxval=1 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return Tensor(1 / (1 + jnp.exp(-(self.logits + logistic)
+                                       / temperature)))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        eps = 1e-12
+        return Tensor(v * jnp.log(self.probs + eps)
+                      + (1 - v) * jnp.log1p(-self.probs + eps))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        p = self.probs
+        eps = 1e-12
+        return Tensor(-(p * jnp.log(p + eps)
+                        + (1 - p) * jnp.log1p(-p + eps)))
+
+    def cdf(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor(jnp.where(v < 0, 0.0,
+                                jnp.where(v < 1, 1 - self.probs, 1.0)))
+
+
+class Categorical(Distribution):
+    """Categorical(logits) — reference `distribution/categorical.py`.
+
+    NOTE reference semantics: `logits` are unnormalised log-probabilities or
+    non-negative relative weights; probs() normalises along the last axis.
+    """
+
+    def __init__(self, logits=None, probs=None, name=None):
+        import jax
+        import jax.numpy as jnp
+
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            p = _arr(probs)
+            self._p = p / p.sum(-1, keepdims=True)
+            self.logits = jnp.log(self._p)
+        else:
+            # keep exact normalized log-probs: log(softmax()) clamps rare
+            # classes at the eps floor and kills their gradient
+            lg = _arr(logits)
+            self.logits = jax.nn.log_softmax(lg, axis=-1)
+            self._p = jnp.exp(self.logits)
+        super().__init__(batch_shape=tuple(np.shape(self._p)[:-1]))
+        self._n = int(np.shape(self._p)[-1])
+
+    @property
+    def probs_array(self):
+        return self._p
+
+    def probs(self, value=None):
+        if value is None:
+            return Tensor(self._p)
+        import jax.numpy as jnp
+
+        v = _arr(value).astype("int32")
+        return Tensor(jnp.take_along_axis(
+            jnp.broadcast_to(self._p, v.shape + (self._n,)),
+            v[..., None], -1)[..., 0])
+
+    def sample(self, shape=(), key=None):
+        import jax
+
+        shp = tuple(int(s) for s in shape) + self.batch_shape
+        out = jax.random.categorical(self._key(key), self.logits, axis=-1,
+                                     shape=shp)
+        return Tensor(out.astype("int64"))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value).astype("int32")
+        return Tensor(jnp.take_along_axis(
+            jnp.broadcast_to(self.logits, v.shape + (self._n,)),
+            v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return Tensor(-(self._p * jnp.log(self._p + 1e-12)).sum(-1))
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+
+        if not isinstance(other, Categorical):
+            return super().kl_divergence(other)
+        return Tensor((self._p * (jnp.log(self._p + 1e-12)
+                                  - jnp.log(other._p + 1e-12))).sum(-1))
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) — `distribution/multinomial.py`."""
+
+    def __init__(self, total_count, probs):
+        import jax.numpy as jnp
+
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs = p / p.sum(-1, keepdims=True)
+        super().__init__(batch_shape=tuple(np.shape(p)[:-1]),
+                         event_shape=tuple(np.shape(p)[-1:]))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), key=None):
+        import jax
+        import jax.numpy as jnp
+
+        shp = tuple(int(s) for s in shape) + self.batch_shape
+        logits = jnp.log(self.probs + 1e-12)
+        k = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            self._key(key), logits, axis=-1,
+            shape=(self.total_count,) + shp)                 # [N, ...]
+        # count draws per category without a [N, ..., K] one-hot blowup
+        flat = draws.reshape(self.total_count, -1).T          # [B, N]
+        counts = jax.vmap(
+            lambda d: jnp.bincount(d, length=k))(flat)        # [B, K]
+        return Tensor(counts.reshape(shp + (k,)).astype(
+            np.result_type(self.probs)))
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value)
+        return Tensor(sp.gammaln(self.total_count + 1.0)
+                      - sp.gammaln(v + 1.0).sum(-1)
+                      + (v * jnp.log(self.probs + 1e-12)).sum(-1))
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate is out of scope — the
+        # reference computes a support enumeration for small counts only
+        raise NotImplementedError
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) — `distribution/binomial.py`."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(batch_shape=tuple(np.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        out = jax.random.binomial(self._key(key), self.total_count,
+                                  self.probs, shape=shp)
+        return Tensor(out.astype("int64"))
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value).astype(np.result_type(self.probs))
+        n = self.total_count
+        comb = (sp.gammaln(n + 1.0) - sp.gammaln(v + 1.0)
+                - sp.gammaln(n - v + 1.0))
+        return Tensor(comb + v * jnp.log(self.probs + 1e-12)
+                      + (n - v) * jnp.log1p(-self.probs + 1e-12))
+
+
+class Poisson(Distribution):
+    """Poisson(rate) — `distribution/poisson.py`."""
+
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(batch_shape=tuple(np.shape(self.rate)))
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=(), key=None):
+        import jax
+
+        shp = self._extend_shape(shape)
+        out = jax.random.poisson(self._key(key), self.rate, shape=shp)
+        return Tensor(out.astype("int64"))
+
+    def log_prob(self, value):
+        import jax.scipy.special as sp
+        import jax.numpy as jnp
+
+        v = _arr(value).astype(np.result_type(self.rate))
+        return Tensor(v * jnp.log(self.rate + 1e-12) - self.rate
+                      - sp.gammaln(v + 1.0))
+
+
+class Geometric(Distribution):
+    """Geometric(probs): failures before first success —
+    `distribution/geometric.py`."""
+
+    def __init__(self, probs):
+        self.probs = _arr(probs)
+        super().__init__(batch_shape=tuple(np.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=(), key=None):
+        import jax
+        import jax.numpy as jnp
+
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(key), shp,
+                               dtype=np.result_type(self.probs, 0.1),
+                               minval=1e-12, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)
+                                ).astype("int64"))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = _arr(value).astype(np.result_type(self.probs))
+        return Tensor(v * jnp.log1p(-self.probs + 1e-12)
+                      + jnp.log(self.probs + 1e-12))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        p = self.probs
+        q = 1 - p
+        return Tensor(-(q * jnp.log(q + 1e-12) + p * jnp.log(p + 1e-12)) / p)

@@ -1,0 +1,102 @@
+"""Distribution base class (reference
+`python/paddle/distribution/distribution.py`).
+
+Probability API over the framework Tensor: sample/rsample/log_prob/prob/
+entropy/cdf + batch broadcasting. Sampling draws fresh keys from the global
+generator (`framework/random.py`) so eager results follow `paddle.seed`;
+under jit/tracing users thread keys via the functional `sample(key=...)`
+escape hatch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+
+__all__ = ["Distribution"]
+
+
+def _arr(x):
+    import jax.numpy as jnp
+
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+class Distribution:
+    """Base of all probability distributions
+    (`distribution/distribution.py:40`)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = (), key=None) -> Tensor:
+        """Draw samples (no gradient flow)."""
+        from ..core import autograd
+
+        with autograd.no_grad():
+            out = self.rsample(shape, key=key)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape: Sequence[int] = (), key=None) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        import jax.numpy as jnp
+
+        return Tensor(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def cdf(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # helpers -----------------------------------------------------------
+    def _key(self, key):
+        if key is not None:
+            return key
+        return random_mod.next_key()
+
+    def _extend_shape(self, sample_shape):
+        return (tuple(int(s) for s in sample_shape) + self.batch_shape
+                + self.event_shape)
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}"
+                f"(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")

@@ -1,0 +1,147 @@
+"""kl_divergence + register_kl dispatch (reference
+`python/paddle/distribution/kl.py:52,84`)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .continuous import (Beta, Dirichlet, Exponential, Gamma, Laplace,
+                         LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric
+from .distribution import Distribution, _arr
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL function (reference kl.py:84)."""
+
+    def decorator(f):
+        _KL_REGISTRY[(cls_p, cls_q)] = f
+        return f
+
+    return decorator
+
+
+def _dispatch(cls_p, cls_q):
+    matches = [(p, q) for (p, q) in _KL_REGISTRY
+               if issubclass(cls_p, p) and issubclass(cls_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p || q) registered for ({cls_p.__name__}, "
+            f"{cls_q.__name__})")
+    # most-derived match wins
+    best = max(matches, key=lambda pq: sum(len(c.__mro__) for c in pq))
+    return _KL_REGISTRY[best]
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """KL(p || q) (reference kl.py:52)."""
+    return _dispatch(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    import jax.numpy as jnp
+
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    import jax.numpy as jnp
+
+    res = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where((q.low <= p.low) & (p.high <= q.high), res,
+                            jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    import jax.numpy as jnp
+
+    eps = 1e-12
+    a, b = p.probs, q.probs
+    t1 = a * (jnp.log(a + eps) - jnp.log(b + eps))
+    t2 = (1 - a) * (jnp.log1p(-a + eps) - jnp.log1p(-b + eps))
+    return Tensor(t1 + t2)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    import jax.scipy.special as sp
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (sp.betaln(a2, b2) - sp.betaln(a1, b1)
+         + (a1 - a2) * sp.digamma(a1) + (b1 - b2) * sp.digamma(b1)
+         + (a2 - a1 + b2 - b1) * sp.digamma(a1 + b1))
+    return Tensor(t)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    import jax.scipy.special as sp
+    import jax.numpy as jnp
+
+    a1, r1, a2, r2 = p.concentration, p.rate, q.concentration, q.rate
+    t = ((a1 - a2) * sp.digamma(a1) - sp.gammaln(a1) + sp.gammaln(a2)
+         + a2 * (jnp.log(r1) - jnp.log(r2)) + a1 * (r2 / r1 - 1))
+    return Tensor(t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    import jax.numpy as jnp
+
+    ratio = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + ratio - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    import jax.numpy as jnp
+
+    scale_ratio = p.scale / q.scale
+    loc_diff = jnp.abs(p.loc - q.loc) / q.scale
+    return Tensor(-jnp.log(scale_ratio) - 1 + loc_diff
+                  + scale_ratio * jnp.exp(-loc_diff / scale_ratio))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    import jax.scipy.special as sp
+
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    t = (sp.gammaln(a0) - sp.gammaln(a).sum(-1)
+         - sp.gammaln(b.sum(-1)) + sp.gammaln(b).sum(-1)
+         + ((a - b) * (sp.digamma(a)
+                       - sp.digamma(a0)[..., None])).sum(-1))
+    return Tensor(t)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._normal, q._normal)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    import jax.numpy as jnp
+
+    eps = 1e-12
+    a, b = p.probs, q.probs
+    return Tensor((jnp.log(a + eps) - jnp.log(b + eps))
+                  + (1 - a) / a * (jnp.log1p(-a + eps)
+                                   - jnp.log1p(-b + eps)))

@@ -1,0 +1,308 @@
+"""Bijective transforms + TransformedDistribution + Independent
+(reference `python/paddle/distribution/transform.py`,
+`transformed_distribution.py`, `independent.py`)."""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _arr
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "AbsTransform", "SigmoidTransform", "TanhTransform",
+           "SoftmaxTransform", "ChainTransform", "StickBreakingTransform",
+           "TransformedDistribution", "Independent"]
+
+
+class Transform:
+    """Base bijector (reference transform.py `Transform`)."""
+
+    _codomain_event_dims = 0
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        import jax.numpy as jnp
+
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        import jax.numpy as jnp
+
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        import jax.numpy as jnp
+
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return x ** self.power
+
+    def _inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def _fldj(self, x):
+        import jax.numpy as jnp
+
+        return jnp.log(jnp.abs(self.power * x ** (self.power - 1)))
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective; inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        import jax.numpy as jnp
+
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        import jax
+
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        import jax
+
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        import jax.numpy as jnp
+
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        import jax
+
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Many-to-one normalisation (no log-det; matches reference)."""
+
+    _codomain_event_dims = 1
+
+    def _forward(self, x):
+        import jax
+
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K via stick breaking (reference transform.py)."""
+
+    _codomain_event_dims = 1
+
+    def _forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate(
+            [z, jnp.ones(z.shape[:-1] + (1,), z.dtype)], -1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        import jax.numpy as jnp
+
+        y_crop = y[..., :-1]
+        rem = 1 - jnp.cumsum(y_crop, -1)
+        offset = jnp.arange(y_crop.shape[-1], 0, -1, dtype=y.dtype)
+        z = y_crop / jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), rem[..., :-1]], -1)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        offset = jnp.arange(x.shape[-1], 0, -1, dtype=x.dtype)
+        xo = x - jnp.log(offset)
+        z = jax.nn.sigmoid(xo)
+        detail = (jnp.log(z) + jnp.log1p(-z)
+                  + jnp.concatenate(
+                      [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+                       jnp.cumsum(jnp.log1p(-z[..., :-1]), -1)], -1))
+        return detail.sum(-1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transforms (reference
+    `transformed_distribution.py`)."""
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = ChainTransform(transforms)
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def rsample(self, shape=(), key=None):
+        x = self.base.rsample(shape, key=key)
+        return self.transform.forward(x)
+
+    def sample(self, shape=(), key=None):
+        x = self.base.sample(shape, key=key)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = _arr(self.base.log_prob(Tensor(x)))
+        ldj = jnp.asarray(self.transform._fldj(x))
+        # elementwise transforms return a per-element ldj over the base's
+        # event dims; reduce until it matches the base log_prob's rank
+        # (transforms with codomain event dims fold theirs in _fldj)
+        while ldj.ndim > jnp.ndim(base_lp):
+            ldj = ldj.sum(-1)
+        return Tensor(base_lp - ldj)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (reference
+    `independent.py`)."""
+
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_rank: int = 1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        super().__init__(
+            batch_shape=bshape[:len(bshape) - self.rank],
+            event_shape=bshape[len(bshape) - self.rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key=key)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key=key)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        for _ in range(self.rank):
+            lp = lp.sum(-1)
+        return Tensor(lp)
+
+    def entropy(self):
+        h = _arr(self.base.entropy())
+        for _ in range(self.rank):
+            h = h.sum(-1)
+        return Tensor(h)

@@ -1,0 +1,192 @@
+"""paddle_tpu.fft — discrete Fourier transforms
+(reference `python/paddle/fft.py`; kernels `paddle/phi/kernels/*/fft_*`).
+
+All 1-D/2-D/N-D c2c, r2c (rfft*), c2r (irfft*, hfft*) variants plus the
+helper frequencies/shift APIs, lowered through `jnp.fft` (XLA's native FFT
+custom-calls on TPU). Norm semantics follow the reference: "backward"
+(default), "ortho", "forward".
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _arr(x):
+    import jax
+    import jax.numpy as jnp
+
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # TPU has no f64/c128 FFT (c128 rejected at compile); the x64 default
+    # would otherwise promote rfft(f64) -> c128. Keep full precision on CPU.
+    if jax.default_backend() == "tpu":
+        if a.dtype == jnp.float64:
+            a = a.astype(jnp.float32)
+        elif a.dtype == jnp.complex128:
+            a = a.astype(jnp.complex64)
+    return a
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("forward", "backward", "ortho"):
+        raise ValueError(f"invalid norm {norm!r}; expected 'forward', "
+                         "'backward' or 'ortho'")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.fft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def ifft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.ifft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def rfft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.rfft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def irfft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.irfft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def hfft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.hfft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def ihfft(x, n=None, axis=-1, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.ihfft(_arr(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def fftn(x, s=None, axes=None, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.fftn(_arr(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def ifftn(x, s=None, axes=None, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.ifftn(_arr(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def rfftn(x, s=None, axes=None, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.rfftn(_arr(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def irfftn(x, s=None, axes=None, norm=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.irfftn(_arr(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def hfftn(x, s=None, axes=None, norm=None, name=None):
+    """N-D hermitian-to-real FFT: separable as c2c fftn over the leading
+    axes then a 1-D hfft (c2r) over the last axis — norms compose per axis
+    exactly like numpy's."""
+    import jax.numpy as jnp
+
+    a = _arr(x)
+    axes = tuple(range(a.ndim)) if axes is None else tuple(axes)
+    nrm = _norm(norm)
+    lead_s = None if s is None else list(s)[:-1]
+    last_n = None if s is None else int(list(s)[-1])
+    if len(axes) > 1:
+        a = jnp.fft.fftn(a, s=lead_s, axes=axes[:-1], norm=nrm)
+    return Tensor(jnp.fft.hfft(a, n=last_n, axis=axes[-1], norm=nrm))
+
+
+def ihfftn(x, s=None, axes=None, norm=None, name=None):
+    """Inverse of hfftn: 1-D ihfft (r2c) on the last axis, then c2c ifftn
+    over the leading axes."""
+    import jax.numpy as jnp
+
+    a = _arr(x)
+    axes = tuple(range(a.ndim)) if axes is None else tuple(axes)
+    nrm = _norm(norm)
+    lead_s = None if s is None else list(s)[:-1]
+    last_n = None if s is None else int(list(s)[-1])
+    out = jnp.fft.ihfft(a, n=last_n, axis=axes[-1], norm=nrm)
+    if len(axes) > 1:
+        out = jnp.fft.ifftn(out, s=lead_s, axes=axes[:-1], norm=nrm)
+    return Tensor(out)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    import jax.numpy as jnp
+
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import dtype as dtype_mod
+
+        out = out.astype(dtype_mod.to_np(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    import jax.numpy as jnp
+
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import dtype as dtype_mod
+
+        out = out.astype(dtype_mod.to_np(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.fftshift(_arr(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.fft.ifftshift(_arr(x), axes=axes))

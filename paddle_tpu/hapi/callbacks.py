@@ -196,7 +196,10 @@ class EarlyStopping(Callback):
         return v > self.best + self.min_delta
 
     def on_eval_end(self, logs=None):
-        v = (logs or {}).get(self.monitor)
+        logs = logs or {}
+        # evaluate() prefixes keys with 'eval_'; accept both spellings so
+        # the default monitor='loss' works out of the box
+        v = logs.get(self.monitor, logs.get("eval_" + self.monitor))
         if v is None:
             return
         v = float(np.asarray(v).ravel()[0])

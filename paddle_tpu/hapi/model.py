@@ -78,14 +78,17 @@ class Model:
             return outs[0]
         return self._loss(*(outs + labels))
 
-    def train_batch(self, inputs, labels=None, update=True):
-        """One optimization step (reference DynamicGraphAdapter:847)."""
+    def train_batch(self, inputs, labels=None, update=True,
+                    grad_scale=1.0):
+        """One optimization step (reference DynamicGraphAdapter:847).
+        `grad_scale` divides the loss under gradient accumulation so the
+        summed micro-batch gradients average instead of adding up."""
         self.network.train()
         ins = _as_tensors(inputs)
         lbs = _as_tensors(labels)
         outputs = self.network(*ins)
         loss = self._compute_loss(outputs, lbs)
-        loss.backward()
+        (loss * grad_scale if grad_scale != 1.0 else loss).backward()
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -184,7 +187,9 @@ class Model:
                 # accumulation counts across epochs (global iteration), so a
                 # partial window never silently leaks into the next epoch
                 update = (it + 1) % accumulate_grad_batches == 0
-                res = self.train_batch(ins, lbs, update=update)
+                res = self.train_batch(
+                    ins, lbs, update=update,
+                    grad_scale=1.0 / accumulate_grad_batches)
                 pending_grads = not update
                 logs = self._pack_logs(res)
                 cbks.on_train_batch_end(step, logs)

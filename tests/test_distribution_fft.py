@@ -1,0 +1,339 @@
+"""distribution package + fft tests (reference `test/distribution/`,
+`test/fft/`): sampling statistics, log_prob/entropy vs scipy, kl pairs,
+transforms, fft round-trips vs numpy."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import fft as pfft
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+def test_normal_moments_logprob_entropy():
+    d = D.Normal(1.5, 2.0)
+    s = _np(d.sample([20000]))
+    assert abs(s.mean() - 1.5) < 0.1 and abs(s.std() - 2.0) < 0.1
+    v = np.asarray([0.3, -1.2, 4.0])
+    np.testing.assert_allclose(_np(d.log_prob(paddle.Tensor(v))),
+                               st.norm(1.5, 2.0).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.norm(1.5, 2.0).entropy(), rtol=1e-6)
+    np.testing.assert_allclose(_np(d.cdf(paddle.Tensor(v))),
+                               st.norm(1.5, 2.0).cdf(v), rtol=1e-5)
+
+
+def test_normal_rsample_reparameterized_grad():
+    import jax
+
+    loc = paddle.Tensor(np.asarray(0.5))
+    # grad of E[x] wrt loc through rsample should be ~1
+    key = jax.random.key(0)
+
+    def f(mu):
+        d = D.Normal(paddle.Tensor(mu), 1.0)
+        return d.rsample([1000], key=key)._data.mean()
+
+    g = jax.grad(f)(0.5)
+    assert abs(float(g) - 1.0) < 1e-5
+
+
+def test_uniform_beta_gamma_vs_scipy():
+    u = D.Uniform(-1.0, 3.0)
+    v = np.asarray([-0.5, 0.0, 2.9])
+    np.testing.assert_allclose(_np(u.log_prob(paddle.Tensor(v))),
+                               st.uniform(-1, 4).logpdf(v), rtol=1e-6)
+    b = D.Beta(2.0, 3.0)
+    vb = np.asarray([0.1, 0.5, 0.9])
+    np.testing.assert_allclose(_np(b.log_prob(paddle.Tensor(vb))),
+                               st.beta(2, 3).logpdf(vb), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(b.entropy())),
+                               st.beta(2, 3).entropy(), rtol=1e-5)
+    g = D.Gamma(3.0, 2.0)
+    vg = np.asarray([0.5, 1.0, 4.0])
+    np.testing.assert_allclose(_np(g.log_prob(paddle.Tensor(vg))),
+                               st.gamma(3, scale=0.5).logpdf(vg), rtol=1e-5)
+    sg = _np(g.sample([20000]))
+    assert abs(sg.mean() - 1.5) < 0.1
+
+
+def test_more_continuous_vs_scipy():
+    cases = [
+        (D.Exponential(2.0), st.expon(scale=0.5), [0.1, 1.0, 3.0]),
+        (D.Laplace(0.5, 1.5), st.laplace(0.5, 1.5), [-2.0, 0.5, 3.0]),
+        (D.LogNormal(0.2, 0.7), st.lognorm(0.7, scale=np.exp(0.2)),
+         [0.5, 1.0, 2.0]),
+        (D.Gumbel(1.0, 2.0), st.gumbel_r(1.0, 2.0), [-1.0, 1.0, 5.0]),
+        (D.Cauchy(0.0, 1.0), st.cauchy(0, 1), [-2.0, 0.0, 2.0]),
+        (D.StudentT(5.0, 0.0, 1.0), st.t(5), [-1.5, 0.0, 2.5]),
+        (D.Chi2(4.0), st.chi2(4), [1.0, 3.0, 8.0]),
+    ]
+    for d, ref, vals in cases:
+        v = np.asarray(vals)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.Tensor(v))), ref.logpdf(v), rtol=1e-4,
+            err_msg=type(d).__name__)
+
+
+def test_dirichlet_and_multinomial():
+    alpha = np.asarray([1.0, 2.0, 3.0])
+    d = D.Dirichlet(alpha)
+    s = _np(d.sample([8000]))
+    np.testing.assert_allclose(s.mean(0), alpha / alpha.sum(), atol=0.02)
+    v = np.asarray([0.2, 0.3, 0.5])
+    np.testing.assert_allclose(float(_np(d.log_prob(paddle.Tensor(v)))),
+                               st.dirichlet(alpha).logpdf(v), rtol=1e-5)
+    m = D.Multinomial(10, np.asarray([0.2, 0.3, 0.5]))
+    sm = _np(m.sample([2000]))
+    assert sm.sum(-1).max() == 10
+    np.testing.assert_allclose(sm.mean(0), [2, 3, 5], atol=0.3)
+    np.testing.assert_allclose(
+        float(_np(m.log_prob(paddle.Tensor(np.asarray([2., 3., 5.]))))),
+        st.multinomial(10, [0.2, 0.3, 0.5]).logpmf([2, 3, 5]), rtol=1e-5)
+
+
+def test_discrete_vs_scipy():
+    bern = D.Bernoulli(0.3)
+    v = np.asarray([0.0, 1.0])
+    np.testing.assert_allclose(_np(bern.log_prob(paddle.Tensor(v))),
+                               st.bernoulli(0.3).logpmf(v), rtol=1e-5)
+    s = _np(bern.sample([20000]))
+    assert abs(s.mean() - 0.3) < 0.02
+
+    binom = D.Binomial(10, 0.4)
+    vb = np.asarray([0, 4, 10])
+    np.testing.assert_allclose(_np(binom.log_prob(paddle.Tensor(vb))),
+                               st.binom(10, 0.4).logpmf(vb), rtol=1e-4)
+
+    pois = D.Poisson(3.0)
+    vp = np.asarray([0, 3, 7])
+    np.testing.assert_allclose(_np(pois.log_prob(paddle.Tensor(vp))),
+                               st.poisson(3.0).logpmf(vp), rtol=1e-5)
+
+    geom = D.Geometric(0.25)
+    vg = np.asarray([0, 2, 5])
+    # scipy geom counts trials (starts at 1); ours counts failures
+    np.testing.assert_allclose(_np(geom.log_prob(paddle.Tensor(vg))),
+                               st.geom(0.25).logpmf(vg + 1), rtol=1e-5)
+
+
+def test_categorical_semantics():
+    logits = np.log(np.asarray([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]]))
+    c = D.Categorical(logits=logits)
+    assert c.batch_shape == (2,)
+    s = _np(c.sample([4000]))
+    assert s.shape == (4000, 2)
+    freq = (s[:, 0][:, None] == np.arange(3)).mean(0)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    ent = _np(c.entropy())
+    ref = [st.entropy([0.2, 0.3, 0.5]), st.entropy([0.6, 0.3, 0.1])]
+    np.testing.assert_allclose(ent, ref, rtol=1e-5)
+
+
+def test_kl_pairs():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), ref,
+                               rtol=1e-6)
+    # kl(p, p) == 0 across families
+    for d in (D.Beta(2.0, 3.0), D.Gamma(3.0, 2.0), D.Exponential(2.0),
+              D.Laplace(0.0, 1.0), D.Bernoulli(0.3),
+              D.Dirichlet(np.asarray([1.0, 2.0])), D.Geometric(0.3),
+              D.LogNormal(0.1, 0.5)):
+        np.testing.assert_allclose(np.sum(_np(D.kl_divergence(d, d))), 0.0,
+                                   atol=1e-6, err_msg=type(d).__name__)
+    # monte-carlo cross check for beta pair
+    pb, qb = D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)
+    s = _np(pb.sample([100000]))
+    mc = (st.beta(2, 3).logpdf(s) - st.beta(4, 1.5).logpdf(s)).mean()
+    np.testing.assert_allclose(float(_np(D.kl_divergence(pb, qb))), mc,
+                               rtol=0.05)
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0., 1.), D.Beta(1., 1.))
+
+
+def test_register_kl_dispatch():
+    class MyNormal(D.Normal):
+        pass
+
+    # subclass falls back to the (Normal, Normal) rule
+    out = D.kl_divergence(MyNormal(0.0, 1.0), D.Normal(0.0, 1.0))
+    np.testing.assert_allclose(float(_np(out)), 0.0, atol=1e-7)
+
+    @D.register_kl(MyNormal, MyNormal)
+    def _custom(p, q):
+        return paddle.Tensor(np.asarray(42.0))
+
+    out = D.kl_divergence(MyNormal(0.0, 1.0), MyNormal(0.0, 1.0))
+    assert float(_np(out)) == 42.0
+
+
+def test_transforms_roundtrip_and_ldj():
+    import jax
+
+    x = np.linspace(-2, 2, 9)
+    for t, domain in [
+        (D.AffineTransform(1.0, 2.5), x),
+        (D.ExpTransform(), x),
+        (D.SigmoidTransform(), x),
+        (D.TanhTransform(), x * 0.9),
+        (D.PowerTransform(3.0), np.abs(x) + 0.1),
+    ]:
+        y = t.forward(paddle.Tensor(domain))
+        back = t.inverse(y)
+        np.testing.assert_allclose(_np(back), domain, atol=1e-5,
+                                   err_msg=type(t).__name__)
+        # ldj vs numeric jacobian
+        fldj = _np(t.forward_log_det_jacobian(paddle.Tensor(domain)))
+        num = np.asarray([float(jax.grad(
+            lambda v: t.forward(paddle.Tensor(v))._data)(float(d)))
+            for d in domain])
+        np.testing.assert_allclose(fldj, np.log(np.abs(num)), atol=1e-4,
+                                   err_msg=type(t).__name__)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    base = D.Normal(0.3, 0.6)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.3, 0.6)
+    v = np.asarray([0.5, 1.0, 2.5])
+    np.testing.assert_allclose(_np(td.log_prob(paddle.Tensor(v))),
+                               _np(ln.log_prob(paddle.Tensor(v))),
+                               rtol=1e-6)
+    s = _np(td.sample([20000]))
+    np.testing.assert_allclose(s.mean(), float(_np(ln.mean)), rtol=0.05)
+
+
+def test_independent_reinterprets_event():
+    base = D.Normal(np.zeros((3, 4)), np.ones((3, 4)))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    v = np.random.default_rng(0).normal(size=(3, 4))
+    lp = _np(ind.log_prob(paddle.Tensor(v)))
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(
+        lp, _np(base.log_prob(paddle.Tensor(v))).sum(-1), rtol=1e-6)
+
+
+def test_stick_breaking_transform():
+    t = D.StickBreakingTransform()
+    x = np.asarray([0.3, -0.5, 1.2])
+    y = _np(t.forward(paddle.Tensor(x)))
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(_np(t.inverse(paddle.Tensor(y))), x,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fft
+# ---------------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8)) + 1j * rng.normal(size=(4, 8))
+    for norm in (None, "ortho", "forward"):
+        np.testing.assert_allclose(
+            _np(pfft.fft(paddle.Tensor(x), norm=norm)),
+            np.fft.fft(x, norm=norm or "backward"), atol=1e-10)
+    np.testing.assert_allclose(
+        _np(pfft.ifft(pfft.fft(paddle.Tensor(x)))), x, atol=1e-10)
+
+
+def test_rfft_irfft_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3, 16))
+    r = pfft.rfft(paddle.Tensor(x))
+    assert _np(r).shape == (3, 9)
+    np.testing.assert_allclose(_np(pfft.irfft(r)), x, atol=1e-10)
+    np.testing.assert_allclose(_np(r), np.fft.rfft(x), atol=1e-10)
+
+
+def test_fft2_fftn_hfft_family():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 6, 8)) + 1j * rng.normal(size=(4, 6, 8))
+    np.testing.assert_allclose(_np(pfft.fft2(paddle.Tensor(x))),
+                               np.fft.fft2(x), atol=1e-9)
+    np.testing.assert_allclose(_np(pfft.fftn(paddle.Tensor(x))),
+                               np.fft.fftn(x), atol=1e-9)
+    xr = rng.normal(size=(5, 12))
+    np.testing.assert_allclose(_np(pfft.rfft2(paddle.Tensor(xr))),
+                               np.fft.rfft2(xr), atol=1e-9)
+    # hfft/ihfft 1-D vs numpy
+    xh = rng.normal(size=(10,)) + 1j * rng.normal(size=(10,))
+    np.testing.assert_allclose(_np(pfft.hfft(paddle.Tensor(xh))),
+                               np.fft.hfft(xh), atol=1e-9)
+    xr1 = rng.normal(size=(16,))
+    np.testing.assert_allclose(_np(pfft.ihfft(paddle.Tensor(xr1))),
+                               np.fft.ihfft(xr1), atol=1e-10)
+
+
+def test_fftfreq_shift():
+    np.testing.assert_allclose(_np(pfft.fftfreq(8, 0.5)),
+                               np.fft.fftfreq(8, 0.5), atol=1e-12)
+    np.testing.assert_allclose(_np(pfft.rfftfreq(8, 0.5)),
+                               np.fft.rfftfreq(8, 0.5), atol=1e-12)
+    x = np.arange(10.0)
+    np.testing.assert_allclose(_np(pfft.fftshift(paddle.Tensor(x))),
+                               np.fft.fftshift(x))
+    np.testing.assert_allclose(
+        _np(pfft.ifftshift(pfft.fftshift(paddle.Tensor(x)))), x)
+
+
+def test_fft_gradients_flow():
+    """fft is differentiable through the op layer (r2c grad)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.abs(jnp.fft.rfft(x)).sum()
+
+    x = np.random.default_rng(3).normal(size=(16,))
+    g = jax.grad(f)(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_package_level_import():
+    assert paddle.distribution is D
+    assert paddle.fft is pfft
+
+
+def test_categorical_rare_class_exact_logits():
+    lg = np.asarray([0.0, -50.0])
+    c = D.Categorical(logits=lg)
+    lp = _np(c.log_prob(paddle.Tensor(np.asarray(1))))
+    assert abs(float(lp) - (-50.0)) < 1e-4  # not clamped at log(1e-12)
+
+
+def test_transformed_distribution_with_event_dims():
+    base = D.Independent(D.Normal(np.zeros(4), np.ones(4)), 1)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = np.asarray([0.5, 1.0, 2.0, 0.7])
+    lp = _np(td.log_prob(paddle.Tensor(v)))
+    assert lp.shape == ()
+    ref = (st.norm(0, 1).logpdf(np.log(v)) - np.log(v)).sum()
+    np.testing.assert_allclose(float(lp), ref, rtol=1e-6)
+
+
+def test_normal_int_args():
+    d = D.Normal(0, 1)   # integer params must not crash sampling
+    s = _np(d.sample([16]))
+    assert s.shape == (16,) and np.issubdtype(s.dtype, np.floating)
+
+
+def test_multinomial_large_count_memory_safe():
+    m = D.Multinomial(100000, np.asarray([0.5, 0.3, 0.2]))
+    s = _np(m.sample([4]))
+    assert s.shape == (4, 3)
+    np.testing.assert_allclose(s.sum(-1), 100000)
+    np.testing.assert_allclose(s.mean(0) / 100000, [0.5, 0.3, 0.2],
+                               atol=0.01)

@@ -166,3 +166,44 @@ def test_early_stopping_saves_best_model(tmp_path):
     m.fit(XorDataset(64), eval_data=XorDataset(32, seed=2), batch_size=32,
           epochs=3, verbose=0, save_dir=str(tmp_path), callbacks=[es])
     assert os.path.exists(str(tmp_path / "best_model.pdparams"))
+
+
+def test_grad_accumulation_scales_loss():
+    """4 accumulated micro-batches must produce the same update as one
+    batch of 4x the size (grads averaged, not summed)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 2)).astype("float32")
+    y = rng.integers(0, 2, 16).astype("int64")
+
+    def run(accum, bs):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 2))
+        m = hapi.Model(net)
+        m.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+
+        class _DS(io.Dataset):
+            def __getitem__(self, i):
+                return x[i], y[i]
+
+            def __len__(self):
+                return 16
+
+        m.fit(_DS(), batch_size=bs, epochs=1, shuffle=False, verbose=0,
+              accumulate_grad_batches=accum)
+        return [np.asarray(p._data) for p in net.parameters()]
+
+    whole = run(1, 16)
+    accum = run(4, 4)
+    for a, b in zip(whole, accum):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_early_stopping_default_monitor_matches_eval_logs():
+    m = _model()
+    es = hapi.EarlyStopping(monitor="loss", patience=0, verbose=0)
+    m.fit(XorDataset(64), eval_data=XorDataset(32, seed=2), batch_size=32,
+          epochs=6, verbose=0, callbacks=[es])
+    # monitor='loss' resolves to 'eval_loss'; wait counter engaged
+    assert es.best < np.inf
