@@ -2,6 +2,7 @@
 (reference `python/paddle/autograd/`)."""
 from ..core.autograd import (enable_grad, grad, is_grad_enabled,  # noqa: F401
                              no_grad, run_backward, set_grad_enabled)
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 
 
@@ -33,4 +34,4 @@ class saved_tensors_hooks:
 
 __all__ = ["PyLayer", "PyLayerContext", "no_grad", "enable_grad",
            "is_grad_enabled", "set_grad_enabled", "grad", "backward",
-           "saved_tensors_hooks"]
+           "saved_tensors_hooks", "jacobian", "hessian", "vjp", "jvp"]

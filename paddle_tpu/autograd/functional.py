@@ -1,0 +1,143 @@
+"""Functional higher-order AD: jacobian / hessian / vjp / jvp.
+
+Reference: `python/paddle/autograd/autograd.py` (paddle.autograd.jacobian/
+hessian) and `python/paddle/incubate/autograd/functional.py`. Built on the
+eager tape's create_graph path (`core/autograd.py _traverse_diff`), the
+GeneralGrad analog of `fluid/eager/general_grad.h:38`.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+from ..core.autograd import grad as _grad
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]
+
+
+def _rows(y):
+    """Iterate scalar components of y as taped scalars."""
+    import jax.numpy as jnp
+
+    n = int(np.prod(y.shape)) if y.shape else 1
+    flat = y.reshape([n]) if y.shape else y.reshape([1])
+    for i in range(n):
+        yield flat[i]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """J[i, j] = d ys_i / d xs_j, computed row-by-row with create_graph so
+    the result itself is differentiable (paddle.autograd.jacobian)."""
+    single_x = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single_x else list(xs)
+    rows = []
+    for yi in _rows(ys):
+        gs = _grad(yi, xs_l, create_graph=True, allow_unused=True)
+        row = []
+        for x, g in zip(xs_l, gs):
+            if g is None:
+                z = Tensor(np.zeros(x.shape, np.asarray(x._data).dtype),
+                           stop_gradient=True)
+                row.append(z.reshape([-1]))
+            else:
+                row.append(g.reshape([-1]))
+        rows.append(row)
+    from ..ops import manipulation as M
+
+    jacs = []
+    for j in range(len(xs_l)):
+        jacs.append(M.stack([r[j] for r in rows], axis=0))
+    if single_x:
+        return jacs[0]
+    return jacs
+
+
+def hessian(ys, xs, batch_axis=None):
+    """H = d^2 ys / d xs^2 for scalar ys (paddle.autograd.hessian)."""
+    if tuple(ys.shape) not in ((), (1,)):
+        raise ValueError("hessian expects a scalar output")
+    single_x = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single_x else list(xs)
+    gs = _grad(ys, xs_l, create_graph=True, allow_unused=False)
+    hs = []
+    for g, x in zip(gs, xs_l):
+        hs.append(jacobian(g, x))
+    if single_x:
+        return hs[0]
+    return hs
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): reverse-mode product (incubate.autograd.vjp)."""
+    single_x = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single_x else list(xs)
+    prev_sg = [x.stop_gradient for x in xs_l]
+    for x in xs_l:
+        x.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        ys_l = ys if isinstance(ys, (list, tuple)) else [ys]
+        if v is None:
+            grad_outputs = [None] * len(ys_l)
+        else:
+            v_l = v if isinstance(v, (list, tuple)) else [v]
+            grad_outputs = list(v_l)
+        gs = _grad(list(ys_l), xs_l, grad_outputs=grad_outputs,
+                   create_graph=True, allow_unused=True)
+    finally:
+        # the requires-grad flip is scoped to this call, not a lasting
+        # side effect on the caller's tensors
+        for x, sg in zip(xs_l, prev_sg):
+            x.stop_gradient = sg
+    return ys, (gs[0] if single_x else gs)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): forward-mode product via double-vjp
+    (transpose of vjp — the standard trick when only reverse mode exists;
+    reference incubate.autograd.jvp uses the same construction)."""
+    import jax.numpy as jnp
+
+    single_x = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single_x else list(xs)
+    prev_sg = [x.stop_gradient for x in xs_l]
+    for x in xs_l:
+        x.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        ys_l = ys if isinstance(ys, (list, tuple)) else [ys]
+        # u: dummy cotangent that requires grad; d(u . dy/dx)/du = J v
+        us = [Tensor(jnp.ones_like(y._data)) for y in ys_l]
+        for u in us:
+            u.stop_gradient = False
+        gs = _grad(list(ys_l), xs_l, grad_outputs=us, create_graph=True,
+                   allow_unused=True)
+    finally:
+        for x, sg in zip(xs_l, prev_sg):
+            x.stop_gradient = sg
+    if v is None:
+        v_l = [Tensor(jnp.ones_like(x._data), stop_gradient=True)
+               for x in xs_l]
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+    # sum_j <g_j, v_j> then differentiate w.r.t. u
+    total = None
+    for g, vv in zip(gs, v_l):
+        if g is None:
+            continue
+        term = (g * vv).sum()
+        total = term if total is None else total + term
+    if total is None:
+        # outputs do not depend on inputs: zero tangents
+        res = [Tensor(jnp.zeros_like(y._data), stop_gradient=True)
+               for y in ys_l]
+    else:
+        outs = _grad(total, us, create_graph=False, allow_unused=True)
+        res = [o if o is not None else Tensor(jnp.zeros_like(y._data),
+                                              stop_gradient=True)
+               for o, y in zip(outs, ys_l)]
+    if not isinstance(ys, (list, tuple)):
+        return ys, res[0]
+    return ys, res

@@ -102,6 +102,12 @@ class GradNodeBase:
         """Consume per-output cotangents, return per-input-slot gradients."""
         raise NotImplementedError
 
+    def run_differentiable(self, ct_tensors):
+        raise NotImplementedError(
+            f"{type(self).__name__} ({self.name}) does not support "
+            "create_graph=True; implement run_differentiable for double "
+            "backward through custom nodes")
+
     def release(self):
         pass
 
@@ -119,15 +125,23 @@ class AccumulationNode(GradNodeBase):
     def run(self, cotangents):
         return []
 
+    def run_differentiable(self, ct_tensors):
+        return []
+
     @property
     def tensor(self):
         return self._tensor_ref()
 
 
 class OpGradNode(GradNodeBase):
-    """Backward of one eager op: wraps the compiled vjp pytree from dispatch."""
+    """Backward of one eager op: wraps the compiled vjp pytree from dispatch.
 
-    __slots__ = ("vjp_fn", "in_mask", "out_is_tuple", "vjp_caller")
+    `primals`/`attrs` are the TensorWrapper analog
+    (`fluid/eager/tensor_wrapper.h:39`): the captured forward inputs that let
+    grad(create_graph=True) re-execute this backward differentiably."""
+
+    __slots__ = ("vjp_fn", "in_mask", "out_is_tuple", "vjp_caller", "primals",
+                 "attrs")
 
     def __init__(self, name, n_outputs, vjp_fn, in_mask, out_is_tuple, vjp_caller):
         super().__init__(name, n_outputs)
@@ -135,6 +149,8 @@ class OpGradNode(GradNodeBase):
         self.in_mask = in_mask  # bool per input slot: participates in grad
         self.out_is_tuple = out_is_tuple
         self.vjp_caller = vjp_caller
+        self.primals = None
+        self.attrs = None
 
     def run(self, cotangents):
         import jax
@@ -167,6 +183,49 @@ class OpGradNode(GradNodeBase):
 
     def release(self):
         self.vjp_fn = None
+        self.primals = None
+        self.attrs = None
+
+    def run_differentiable(self, ct_tensors):
+        """Backward as TAPED eager ops: returns per-input-slot gradient
+        Tensors (or None). The double-grad engine
+        (`fluid/eager/general_grad.h:38` GeneralGrad analog)."""
+        from . import dispatch
+        from .tensor import Tensor
+
+        if self.primals is None:
+            raise RuntimeError(
+                f"node {self.name} has no captured primal inputs (the graph "
+                "was released by a prior backward(retain_graph=False), or "
+                "this is a custom node without double-backward support)")
+        # rebuild shell Tensors from the TensorWrapper snapshots: same data,
+        # same tape edge, no dependence on the (possibly mutated) original
+        prims = []
+        for p in self.primals:
+            if isinstance(p, tuple) and len(p) == 5 and p[0] == "__tensor__":
+                _, data, gn, oi, sg = p
+                shell = Tensor(data, stop_gradient=sg)
+                shell._grad_node = gn
+                shell._out_index = oi if oi is not None else 0
+                prims.append(shell)
+            else:
+                prims.append(p)
+        cts = []
+        for i, ct in enumerate(ct_tensors):
+            if ct is None:
+                shape, dt = self.out_avals[i]
+                from ..framework.dtype import is_inexact_np
+
+                z = np.zeros(shape, dt if is_inexact_np(dt) else np.float32)
+                cts.append(Tensor(z, stop_gradient=True))
+            else:
+                cts.append(ct)
+        grads = dispatch.apply_vjp(self.name, prims, self.attrs, cts,
+                                   self.in_mask, self.out_is_tuple)
+        if not isinstance(grads, (list, tuple)):
+            grads = [grads]
+        return [g if self.in_mask[i] else None
+                for i, g in enumerate(grads)]
 
 
 # ---------------------------------------------------------------------------
@@ -229,10 +288,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     """paddle.grad — compute grads of outputs w.r.t. inputs without touching .grad."""
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported by the eager tape yet; "
-            "use paddle_tpu.incubate.autograd or graph mode (jax.grad composition).")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -251,16 +306,25 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             continue
         targets.setdefault(pair, []).append(idx)
 
-    grads_by_node = _seed_cotangents(outputs, grad_outputs)
-    captured = _traverse(grads_by_node, retain_graph=retain,
-                         capture_pairs=set(targets.keys()))
+    if create_graph:
+        grads_by_node = _seed_cotangents_diff(outputs, grad_outputs)
+        captured = _traverse(grads_by_node, retain_graph=True,
+                             capture_pairs=set(targets.keys()),
+                             differentiable=True)
+    else:
+        grads_by_node = _seed_cotangents(outputs, grad_outputs)
+        captured = _traverse(grads_by_node, retain_graph=retain,
+                             capture_pairs=set(targets.keys()))
     results = [None] * len(inputs)
     for (node, oidx), idxs in targets.items():
         cts = captured.get(node)
         g = cts[oidx] if cts is not None else None
         for i in idxs:
             if g is not None:
-                results[i] = Tensor(g, stop_gradient=True)
+                if create_graph:
+                    results[i] = g  # Tensor, still on the tape
+                else:
+                    results[i] = Tensor(g, stop_gradient=True)
             elif not allow_unused:
                 raise RuntimeError(f"gradient for input {i} is unused; "
                                    "pass allow_unused=True to get None")
@@ -315,8 +379,15 @@ def _apply_hooks(node, cts):
     return new
 
 
-def _traverse(grads_by_node, retain_graph, capture_pairs=None):
-    """Kahn's algorithm over the reverse graph; returns node -> final cotangent list."""
+def _traverse(grads_by_node, retain_graph, capture_pairs=None,
+              differentiable=False):
+    """Kahn's algorithm over the reverse graph; returns node -> final
+    cotangent list.
+
+    `differentiable=True` is the create_graph mode: cotangents are Tensors,
+    each node's backward re-executes as taped eager ops
+    (run_differentiable), and the graph is implicitly retained (compiled
+    vjp buffers are never consumed)."""
     reachable, pending = _discover(list(grads_by_node.keys()))
     acc: Dict[GradNodeBase, List[Optional[object]]] = dict(grads_by_node)
     captured: Dict[GradNodeBase, List[Optional[object]]] = {}
@@ -329,14 +400,18 @@ def _traverse(grads_by_node, retain_graph, capture_pairs=None):
             continue
         processed.add(node)
         cts = acc.pop(node, [None] * node.n_outputs)
-        cts = _apply_hooks(node, cts)
+        cts = (_apply_hooks_diff(node, cts) if differentiable
+               else _apply_hooks(node, cts))
         if isinstance(node, AccumulationNode) or (
                 capture_pairs is not None and any(
                     (node, i) in capture_pairs for i in range(node.n_outputs))):
             captured[node] = cts
-        in_grads = node.run(cts)
-        if not retain_graph:
-            node.release()
+        if differentiable:
+            in_grads = node.run_differentiable(cts)
+        else:
+            in_grads = node.run(cts)
+            if not retain_graph:
+                node.release()
         for slot, g in enumerate(in_grads):
             edge = node.edges[slot] if slot < len(node.edges) else None
             if edge is None:
@@ -350,6 +425,47 @@ def _traverse(grads_by_node, retain_graph, capture_pairs=None):
                 if waiting[parent] == 0:
                     ready.append(parent)
     return captured
+
+
+def _seed_cotangents_diff(tensors, grad_tensors):
+    """Seed cotangents as TENSORS (create_graph path): grad_outputs that
+    require grad stay on the tape."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    grads_by_node: Dict[GradNodeBase, List[Optional[object]]] = {}
+    for t, g in zip(tensors, grad_tensors):
+        pair = _pair_of(t)
+        if pair is None:
+            continue
+        node, idx = pair
+        if g is None:
+            ct = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        else:
+            ct = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                        stop_gradient=True)
+        lst = grads_by_node.setdefault(node, [None] * node.n_outputs)
+        lst[idx] = _add(lst[idx], ct)
+    return grads_by_node
+
+
+def _apply_hooks_diff(node, cts):
+    from .tensor import Tensor
+
+    if not any(node.out_hooks):
+        return cts
+    new = list(cts)
+    for i, hooks in enumerate(node.out_hooks):
+        if not hooks or new[i] is None:
+            continue
+        g = new[i]
+        for h in list(hooks):
+            r = h(g)
+            if r is not None:
+                g = r if isinstance(r, Tensor) else Tensor(r)
+        new[i] = g
+    return new
 
 
 def _accumulate_into_grad(t, ct):

@@ -280,6 +280,25 @@ def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     node = autograd.OpGradNode(op.name, len(outs), vjp_fn, mask, out_is_tuple,
                                _vjp_caller())
     node.out_avals = [(tuple(o.shape), np.dtype(o.dtype)) for o in outs]
+    # TensorWrapper analog (`fluid/eager/tensor_wrapper.h:39`): snapshot the
+    # primal inputs + attrs so grad(create_graph=True) can re-execute this
+    # node's backward as taped eager ops (vjp-of-vjp). Stored as
+    # (data, grad_node, out_index, stop_gradient) tuples — the data array is
+    # frozen at forward time (in-place set_value cannot corrupt the second
+    # backward) and no strong ref to the user Tensor object is kept; cleared
+    # by release() together with the vjp buffers.
+    snap = []
+    for t in tensor_inputs:
+        if isinstance(t, Tensor):
+            gn = t._grad_node
+            oi = t._out_index
+            if gn is None and not t.stop_gradient and _differentiable(t._data):
+                gn, oi = t._ensure_accum_node(), 0
+            snap.append(("__tensor__", t._data, gn, oi, t.stop_gradient))
+        else:
+            snap.append(t)
+    node.primals = snap
+    node.attrs = dict(attrs)
     for t in tensor_inputs:
         if isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data):
             if t._grad_node is not None:
@@ -303,6 +322,67 @@ def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     if not out_is_tuple:
         return results[0]
     return results
+
+
+def apply_vjp(op_name: str, primal_inputs, attrs, ct_tensors, mask,
+              out_is_tuple):
+    """Differentiable backward of one op: runs `vjp(op)(cts)` THROUGH the
+    eager dispatch layer, so the produced gradients carry their own grad
+    nodes (the double-grad path, reference `fluid/eager/general_grad.h:38`).
+
+    primal_inputs: the node's captured forward inputs (Tensors / raw);
+    ct_tensors: per-output cotangents (Tensors, zero-filled by the caller).
+    """
+    meta_name = f"__vjp__{op_name}"
+    if meta_name not in _OP_REGISTRY:
+        base_fn = _OP_REGISTRY[op_name].fn
+        register_op(meta_name, _make_generic_vjp(base_fn), multi_out=True)
+    call_attrs = {f"__a_{k}": v for k, v in (attrs or {}).items()}
+    call_attrs["__n"] = len(primal_inputs)
+    call_attrs["__mask"] = tuple(mask)
+    call_attrs["__tuple"] = bool(out_is_tuple)
+    return apply(meta_name, list(primal_inputs) + list(ct_tensors),
+                 call_attrs)
+
+
+def _make_generic_vjp(base_fn):
+    def generic_vjp(*arrays, **kw):
+        jax = _jax()
+        n = kw.pop("__n")
+        mask = kw.pop("__mask")
+        is_tuple = kw.pop("__tuple")
+        op_attrs = {k[len("__a_"):]: v for k, v in kw.items()}
+        primals = arrays[:n]
+        cts = list(arrays[n:])
+        f = functools.partial(base_fn, **op_attrs) if op_attrs else base_fn
+        prims = [p if m else jax.lax.stop_gradient(p)
+                 for p, m in zip(primals, mask)]
+        out, vjp_fn = jax.vjp(lambda *xs: f(*xs), *prims)
+        outs = list(out) if is_tuple else [out]
+        from ..framework.dtype import is_inexact_np
+
+        fixed = []
+        for o, ct in zip(outs, cts):
+            if not is_inexact_np(np.dtype(o.dtype)):
+                # integer/bool outputs take symbolic-zero cotangents
+                fixed.append(np.zeros(o.shape, jax.dtypes.float0))
+            else:
+                fixed.append(ct.astype(o.dtype) if ct.dtype != o.dtype
+                             else ct)
+        grads = vjp_fn(tuple(fixed) if is_tuple else fixed[0])
+        # float0 grads (non-diff inputs) -> zeros so the op has uniform
+        # array outputs; the autograd layer masks them out via in_mask
+        clean = []
+        for g, p in zip(grads, primals):
+            if g is None or (hasattr(g, "dtype")
+                             and g.dtype == jax.dtypes.float0):
+                clean.append(jax.numpy.zeros(() if p is None
+                                             else jax.numpy.shape(p)))
+            else:
+                clean.append(g)
+        return tuple(clean)
+
+    return generic_vjp
 
 
 def _wrap(op, out, stop_gradient):

@@ -145,3 +145,135 @@ def test_softmax_ce_style_grad():
     loss.backward()
     assert logits.grad is not None
     assert np.isfinite(logits.grad.numpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# double grad / create_graph (reference `fluid/eager/general_grad.h:38`)
+# ---------------------------------------------------------------------------
+
+
+def test_double_grad_matches_jax_composition():
+    """grad(grad) through the eager tape equals jax.grad(jax.grad) for a
+    mix of ops (pow, exp, sin, matmul, tanh, division)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(4,))
+    wv = rng.normal(size=(4, 4))
+
+    cases = [
+        ("cube", lambda x: (x * x * x).sum(),
+         lambda a: (a ** 3).sum()),
+        ("exp", lambda x: paddle.exp(x).sum(),
+         lambda a: jnp.exp(a).sum()),
+        ("sin", lambda x: paddle.sin(x).sum(),
+         lambda a: jnp.sin(a).sum()),
+        ("tanh", lambda x: paddle.tanh(x * x).sum(),
+         lambda a: jnp.tanh(a * a).sum()),
+        ("div", lambda x: (1.0 / (x * x + 1.0)).sum(),
+         lambda a: (1.0 / (a * a + 1.0)).sum()),
+        ("matmul", lambda x: paddle.matmul(
+            paddle.Tensor(wv), x.reshape([4, 1])).sum(),
+         lambda a: (jnp.asarray(wv) @ a.reshape(4, 1)).sum()),
+    ]
+    for name, pf, jf in cases:
+        x = paddle.Tensor(xv.copy())
+        x.stop_gradient = False
+        y = pf(x)
+        (g1,) = paddle.grad(y, [x], create_graph=True)
+        (g2,) = paddle.grad(g1.sum(), [x])
+        jg2 = jax.grad(lambda a: jax.grad(jf)(a).sum())(jnp.asarray(xv))
+        np.testing.assert_allclose(np.asarray(g2._data), np.asarray(jg2),
+                                   rtol=1e-5, atol=1e-7, err_msg=name)
+
+
+def test_triple_grad():
+    import jax
+    import jax.numpy as jnp
+
+    x = paddle.Tensor(np.asarray(0.7))
+    x.stop_gradient = False
+    y = paddle.sin(x * x)
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    (g3,) = paddle.grad(g2, [x])
+    f = lambda a: jnp.sin(a * a)
+    ref = jax.grad(jax.grad(jax.grad(f)))(0.7)
+    np.testing.assert_allclose(float(g3._data), float(ref), rtol=1e-6)
+
+
+def test_double_grad_multivar_cross_terms():
+    """d/dx of (dy/dw) exercises cross second derivatives."""
+    import jax
+    import jax.numpy as jnp
+
+    xv = np.asarray([0.5, -1.0])
+    wv = np.asarray([2.0, 3.0])
+    x = paddle.Tensor(xv.copy()); x.stop_gradient = False
+    w = paddle.Tensor(wv.copy()); w.stop_gradient = False
+    y = ((x * w) ** 2).sum()
+    (gw,) = paddle.grad(y, [w], create_graph=True)
+    (gx,) = paddle.grad(gw.sum(), [x])
+    jf = lambda a, b: ((a * b) ** 2).sum()
+    ref = jax.grad(lambda a, b: jax.grad(jf, argnums=1)(a, b).sum())(
+        jnp.asarray(xv), jnp.asarray(wv))
+    np.testing.assert_allclose(np.asarray(gx._data), np.asarray(ref),
+                               rtol=1e-6)
+
+
+def test_hessian_and_jacobian():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.autograd import hessian, jacobian
+
+    xv = np.asarray([0.3, -0.8, 1.2])
+    x = paddle.Tensor(xv.copy()); x.stop_gradient = False
+    y = (paddle.exp(x) * x).sum()
+    h = hessian(y, x)
+    ref_h = jax.hessian(lambda a: (jnp.exp(a) * a).sum())(jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(h._data), np.asarray(ref_h),
+                               rtol=1e-5)
+
+    x2 = paddle.Tensor(xv.copy()); x2.stop_gradient = False
+    y2 = paddle.sin(x2)
+    j = jacobian(y2, x2)
+    ref_j = jax.jacobian(jnp.sin)(jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(j._data), np.asarray(ref_j),
+                               rtol=1e-5)
+
+
+def test_vjp_jvp_functional():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.autograd import jvp, vjp
+
+    xv = np.asarray([0.4, 0.9])
+
+    def f(x):
+        return paddle.exp(x) * x
+
+    x = paddle.Tensor(xv.copy())
+    v = paddle.Tensor(np.asarray([1.0, 2.0]))
+    ys, g = vjp(f, x, v)
+    _, ref = jax.vjp(lambda a: jnp.exp(a) * a, jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(g._data),
+                               np.asarray(ref(jnp.asarray([1.0, 2.0]))[0]),
+                               rtol=1e-6)
+    x = paddle.Tensor(xv.copy())
+    ys, jv = jvp(f, x, paddle.Tensor(np.asarray([1.0, 2.0])))
+    _, ref_jv = jax.jvp(lambda a: jnp.exp(a) * a, (jnp.asarray(xv),),
+                        (jnp.asarray([1.0, 2.0]),))
+    np.testing.assert_allclose(np.asarray(jv._data), np.asarray(ref_jv),
+                               rtol=1e-6)
+
+
+def test_double_grad_with_grad_outputs_on_tape():
+    """grad_outputs that require grad participate in the second backward."""
+    x = paddle.Tensor(np.asarray([1.0, 2.0])); x.stop_gradient = False
+    s = paddle.Tensor(np.asarray([3.0, 4.0])); s.stop_gradient = False
+    y = x * x
+    (g1,) = paddle.grad([y], [x], grad_outputs=[s], create_graph=True)
+    # g1 = 2 x s; d(g1.sum())/ds = 2x
+    (gs,) = paddle.grad(g1.sum(), [s])
+    np.testing.assert_allclose(np.asarray(gs._data), [2.0, 4.0], rtol=1e-6)
