@@ -30,6 +30,9 @@ def _rows(y):
 def jacobian(ys, xs, batch_axis=None):
     """J[i, j] = d ys_i / d xs_j, computed row-by-row with create_graph so
     the result itself is differentiable (paddle.autograd.jacobian)."""
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis (per-sample batched jacobian) is not implemented")
     single_x = not isinstance(xs, (list, tuple))
     xs_l = [xs] if single_x else list(xs)
     rows = []
@@ -55,17 +58,19 @@ def jacobian(ys, xs, batch_axis=None):
 
 
 def hessian(ys, xs, batch_axis=None):
-    """H = d^2 ys / d xs^2 for scalar ys (paddle.autograd.hessian)."""
+    """Full block Hessian for scalar ys (paddle.autograd.hessian):
+    H[i][j] = d^2 ys / d xs_i d xs_j including cross blocks."""
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis (per-sample batched hessian) is not implemented")
     if tuple(ys.shape) not in ((), (1,)):
         raise ValueError("hessian expects a scalar output")
     single_x = not isinstance(xs, (list, tuple))
     xs_l = [xs] if single_x else list(xs)
     gs = _grad(ys, xs_l, create_graph=True, allow_unused=False)
-    hs = []
-    for g, x in zip(gs, xs_l):
-        hs.append(jacobian(g, x))
+    hs = [[jacobian(g, x) for x in xs_l] for g in gs]
     if single_x:
-        return hs[0]
+        return hs[0][0]
     return hs
 
 

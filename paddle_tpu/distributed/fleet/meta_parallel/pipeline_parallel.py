@@ -68,18 +68,27 @@ def build_schedule(schedule: str, n_stages: int, n_micro: int,
     - FThenB: forwards before backwards -> all M activations live at peak.
     - 1F1B / VPP: backwards as soon as ready -> peak live activations per
       stage is bounded by the pipeline depth, not M.
+    - ZBH1 / ZBVPP (zero-bubble, reference
+      `pipeline_scheduler_pass/pipeline_zero_bubble.py:61,151`): each B is
+      SPLIT into "BX" (input/dgrad — on the critical path, scheduled like
+      1F1B's B) and "BW" (weight grad — no cross-stage deps, fills the
+      warmup/cooldown bubbles). Work items then use ops {"F","BX","BW"}
+      and the measured bubble drops below 1F1B's.
     """
     sched = schedule.upper().replace("-", "")
     S, M, V = int(n_stages), int(n_micro), max(1, int(n_chunks))
     n_virt = S * V
+    zero_bubble = sched in ("ZBH1", "ZB", "ZBVPP")
     prefer_b = sched not in ("FTHENB",)
     # per-virtual-stage FIFO queues (micro order)
     f_q = {vs: list(range(M)) for vs in range(n_virt)}
     b_q = {vs: list(range(M)) for vs in range(n_virt)}
+    w_q = {vs: list(range(M)) for vs in range(n_virt)} if zero_bubble else {}
     fwd_done, bwd_done = set(), set()
-    live = {d: 0 for d in range(S)}  # in-flight micros (F issued, B not yet)
+    live = {d: 0 for d in range(S)}  # in-flight micros (F issued, BX not yet)
     slots: List[List[tuple]] = []
-    total = 2 * n_virt * M
+    b_op = "BX" if zero_bubble else "B"
+    total = (3 if zero_bubble else 2) * n_virt * M
     done = 0
     while done < total:
         slot = []
@@ -100,11 +109,18 @@ def build_schedule(schedule: str, n_stages: int, n_micro: int,
                     m = b_q[vs][0]
                     if (vs, m) in fwd_done and (
                             vs == n_virt - 1 or (vs + 1, m) in bwd_done):
-                        cands.append(("B", vs, c, m))
+                        cands.append((b_op, vs, c, m))
+                if zero_bubble and w_q[vs]:
+                    m = w_q[vs][0]
+                    if (vs, m) in bwd_done:
+                        cands.append(("BW", vs, c, m))
             if not cands:
                 continue
+            # priority: dgrad first (critical path), then forwards, weight
+            # grads last — they only fill otherwise-idle slots
             if prefer_b:
-                picks = [x for x in cands if x[0] == "B"] or cands
+                picks = ([x for x in cands if x[0] == b_op]
+                         or [x for x in cands if x[0] == "F"] or cands)
             else:
                 picks = [x for x in cands if x[0] == "F"] or cands
             op, vs, c, m = min(picks, key=lambda x: (x[3], x[2]))
@@ -119,6 +135,8 @@ def build_schedule(schedule: str, n_stages: int, n_micro: int,
                 f_q[vs].pop(0)
                 fwd_done.add((vs, m))
                 live[d] += 1
+            elif op == "BW":
+                w_q[vs].pop(0)
             else:
                 b_q[vs].pop(0)
                 bwd_done.add((vs, m))
@@ -308,7 +326,12 @@ class PipelineParallel:
                         store[(vs, m)] = (x_in, y)
                     live[d] += 1
                     peak[d] = max(peak[d], live[d])
-                else:  # backward of virtual stage vs for micro m
+                elif op == "BW":
+                    # eager engine computes wgrad together with dgrad at the
+                    # BX step (a per-stage `paddle.grad` yields both); the
+                    # BW slot exists for schedule/bubble accounting
+                    continue
+                else:  # backward (dgrad[+wgrad]) of virtual stage vs, micro m
                     x_in, out = store.pop((vs, m))
                     live[d] -= 1
                     params = self._segment_params(vs)
@@ -399,65 +422,127 @@ class PipelineParallel:
 # the compiled (one-XLA-program) path
 # ---------------------------------------------------------------------------
 
+def pipeline_ticks(n_stages: int, n_micro: int, n_chunks: int = 1) -> int:
+    """Scan trip count of the compiled pipeline: V*ceil(M/S)*S + S - 1 for
+    the interleaved schedule (== V*M + S - 1 when S | M), M + S - 1 for
+    V=1. Compiled bubble fraction = 1 - V*M / ticks."""
+    S, M, V = int(n_stages), int(n_micro), max(1, int(n_chunks))
+    if V == 1:
+        return M + S - 1
+    import math
+
+    return V * math.ceil(M / S) * S + S - 1
+
+
+_scan_jit_cache: dict = {}
+
+
 def scan_pipeline(stage_fn, stage_params, inputs, n_micro: int,
-                  axis_name: str = "pp", mesh=None):
+                  axis_name: str = "pp", mesh=None, n_chunks: int = 1):
     """Compiled pipeline as one XLA program (the TPU-native path).
 
-    stage_fn(params, x) -> y: one pipeline stage; per-stage weights differ
-    but the pytree structure and the x->y aval must match across stages
-    (the transformer-stack case — embed/head belong in `first_fn`/`last_fn`
-    of `pipeline_train_step`). stage_params: pytree whose leaves are stacked
-    on dim0 over the `pp` mesh axis (stage i's weights live on pp coordinate
-    i). inputs: [n_micro, micro_batch, ...] micro-batch stack.
+    stage_fn(params, x) -> y: one virtual pipeline stage; per-stage weights
+    differ but the pytree structure and the x->y aval must match across
+    stages (the transformer-stack case — embed/head belong in
+    `first_fn`/`last_fn` of `pipeline_train_step`). x/y may be arbitrary
+    pytrees (multi-tensor boundaries).
 
-    Runs inside `shard_map` over the pp axis: each step every stage works on
-    a different micro-batch; the carry `ppermute`s stage outputs to the next
-    stage over ICI. Total steps = n_micro + n_stages - 1 (the classic
-    pipeline trapezoid — bubble fraction (S-1)/(M+S-1)).
+    stage_params: pytree with leaves stacked [S, ...] (or [S, V, ...] when
+    n_chunks=V>1) — stage i's (chunked) weights live on pp coordinate i.
+    inputs: pytree of [n_micro, micro_batch, ...] micro stacks.
+
+    Runs inside `shard_map` over the pp axis as ONE `lax.scan`:
+    - V=1: at tick t stage s works micro-batch t-s; the carry `ppermute`s
+      stage outputs around the ICI ring. Ticks = M + S - 1.
+    - V>1 (VPP): the true interleaved schedule inside the SAME scan — at
+      tick t, stage s computes chunk c = (t-s) % (S*V) // S of micro-batch
+      m = ((t-s) // (S*V)) * S + (t-s) % S (micro-batches in groups of S,
+      Megatron interleaved order). Every tick each stage both computes and
+      forwards its output, so one scan covers all V chunks and the bubble
+      is (S-1)/(V*M + S-1) — V times smaller than V sequential scans.
+
+    Output: pytree of [n_micro, micro_batch, ...] — the LAST stage's
+    results, fetched by slicing the pp-stacked shard_map output (a single
+    shard transfer, not the old full psum broadcast).
     """
     import jax
     import jax.numpy as jnp
 
     if mesh is None:
         mesh = _current_mesh()
-    n_stages = mesh.shape[axis_name]
+    S = mesh.shape[axis_name]
+    V = max(1, int(n_chunks))
+    M = int(n_micro)
+    ticks = pipeline_ticks(S, M, V)
 
     def per_stage(params, xs):
-        # params: this stage's weights (leading stacked dim removed by
-        # shard_map); xs: the micro stack [n_micro, mb, ...] (replicated)
         stage = jax.lax.axis_index(axis_name)
+        # drop the shard_map-split stage dim: leaves [V, ...] or [...]
         params = jax.tree.map(lambda p: p[0], params)
 
-        state = jnp.zeros_like(xs[0])
-        outputs = jnp.zeros_like(xs)
+        state0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), xs)
+        out0 = jax.tree.map(jnp.zeros_like, xs)
 
         def step(carry, t):
             state, outputs = carry
-            # stage 0 ingests micro-batch t; others take the permuted carry
-            mb_idx = jnp.clip(t, 0, xs.shape[0] - 1)
-            x_in = jnp.where(stage == 0, xs[mb_idx], state)
-            y = stage_fn(params, x_in)
-            # shift stage outputs to the next stage around the pp ring (ICI)
-            nxt = jax.lax.ppermute(
-                y, axis_name,
-                [(i, (i + 1) % n_stages) for i in range(n_stages)])
-            # last stage records its result for micro-batch t-(S-1)
-            out_idx = jnp.clip(t - (n_stages - 1), 0, xs.shape[0] - 1)
-            take = (t >= n_stages - 1) & (stage == n_stages - 1)
-            outputs = jnp.where(take, outputs.at[out_idx].set(y), outputs)
+            tp = t - stage
+            if V == 1:
+                c = jnp.int32(0)
+                m = tp
+            else:
+                r = jnp.mod(tp, S * V)
+                c = r // S
+                m = (tp // (S * V)) * S + jnp.mod(tp, S)
+            valid = (tp >= 0) & (m >= 0) & (m < M)
+            c = jnp.clip(c, 0, V - 1)
+            midx = jnp.clip(m, 0, M - 1)
+            inject = (stage == 0) & (c == 0)
+            x_in = jax.tree.map(
+                lambda xl, st: jnp.where(inject, xl[midx], st), xs, state)
+            if V == 1:
+                pc = params
+            else:
+                pc = jax.tree.map(lambda p: jnp.take(p, c, axis=0), params)
+            y = stage_fn(pc, x_in)
+            # shift outputs to the next stage around the pp ring (ICI);
+            # the wrap S-1 -> 0 carries chunk c to chunk c+1 under VPP
+            nxt = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    a, axis_name, [(i, (i + 1) % S) for i in range(S)]), y)
+            take = valid & (stage == S - 1) & (c == V - 1)
+            outputs = jax.tree.map(
+                lambda o, yl: jnp.where(take, o.at[midx].set(yl), o),
+                outputs, y)
             return (nxt, outputs), None
 
-        (_, outputs), _ = jax.lax.scan(
-            step, (state, outputs), jnp.arange(xs.shape[0] + n_stages - 1))
-        # only the last stage wrote anything; psum broadcasts it to all
-        return jax.lax.psum(outputs, axis_name)
+        (_, outputs), _ = jax.lax.scan(step, (state0, out0),
+                                       jnp.arange(ticks))
+        # leading unit dim becomes the pp-stacked dim of the global output
+        return jax.tree.map(lambda o: o[None], outputs)
 
     from jax.sharding import PartitionSpec as P
 
+    # only the pp axis is manual; any other mesh axes (dp/mp/sp) stay
+    # automatic — GSPMD shards the stage body over them from the data/param
+    # shardings, composing pipeline with tensor/data parallelism in ONE
+    # program (SURVEY.md §7.3 hard-part 2)
     fn = jax.shard_map(per_stage, mesh=mesh,
-                       in_specs=(P(axis_name), P()), out_specs=P(),
-                       check_vma=False)
-    return fn(stage_params, inputs)
+                       in_specs=(P(axis_name), P()),
+                       out_specs=P(axis_name),
+                       axis_names=frozenset({axis_name}), check_vma=False)
+    # partial-manual shard_map needs jit to resolve the auto axes (nested
+    # jit inlines when the caller is already tracing); the wrapper is
+    # cached so repeated eager calls with the same stage_fn/mesh/shape
+    # reuse one compiled program
+    jitted = _scan_jit_cache.get((stage_fn, mesh, axis_name, V, M))
+    if jitted is None:
+        if len(_scan_jit_cache) > 64:
+            _scan_jit_cache.clear()
+        jitted = _scan_jit_cache[(stage_fn, mesh, axis_name, V, M)] = \
+            jax.jit(fn)
+    stacked_out = jitted(stage_params, inputs)
+    # only the last stage's block is real data: one shard fetch, no psum
+    return jax.tree.map(lambda o: o[S - 1], stacked_out)
 
 
 def pipeline_train_step(stage_fn, stacked_params, inputs, labels, *,
@@ -475,14 +560,15 @@ def pipeline_train_step(stage_fn, stacked_params, inputs, labels, *,
       so backward rematerialises per step — the compiled counterpart of the
       1F1B bounded-memory profile.
     - n_chunks > 1 (VPP): stacked_params leaves carry an extra leading chunk
-      dim [V, S, ...]; micro-batches traverse V chained scans — the
-      interleaved virtual-stage layout (reference
-      `PipelineParallelWithInterleave:1161`).
+      dim [V, S, ...]; all V chunks run interleaved inside ONE scan
+      (see `scan_pipeline`), so the bubble is (S-1)/(V*M + S-1) — the
+      reference `PipelineParallelWithInterleave:1161` profile.
 
     Differentiating through `ppermute` gives the reverse-direction cotangent
     ring for free — the backward p2p the reference hand-writes.
     """
     import jax
+    import jax.numpy as jnp
 
     sched = schedule.upper().replace("-", "")
     sfn = stage_fn if sched == "FTHENB" else jax.checkpoint(stage_fn)
@@ -493,13 +579,10 @@ def pipeline_train_step(stage_fn, stacked_params, inputs, labels, *,
         mb = x.shape[0] // n_micro
         micros = x.reshape((n_micro, mb) + tuple(x.shape[1:]))
         if n_chunks > 1:
-            for c in range(n_chunks):
-                chunk = jax.tree.map(lambda p: p[c], stacked)
-                micros = scan_pipeline(sfn, chunk, micros, n_micro,
-                                       axis_name, mesh=mesh)
-        else:
-            micros = scan_pipeline(sfn, stacked, micros, n_micro,
-                                   axis_name, mesh=mesh)
+            # external layout [V, S, ...] -> scan layout [S, V, ...]
+            stacked = jax.tree.map(lambda p: jnp.swapaxes(p, 0, 1), stacked)
+        micros = scan_pipeline(sfn, stacked, micros, n_micro, axis_name,
+                               mesh=mesh, n_chunks=n_chunks)
         y = micros.reshape((n_micro * mb,) + tuple(micros.shape[2:]))
         out = last_fn(lp, y) if last_fn is not None else y
         return loss_fn(out, labels)

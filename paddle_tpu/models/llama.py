@@ -248,6 +248,57 @@ class LlamaForCausalLM(nn.Layer):
             return logits, caches
         return logits
 
+    def pipeline_parts(self):
+        """Decompose for the compiled pipeline (`scan_pipeline` /
+        `pipeline_train_step` / auto-parallel Engine pp): returns
+        ``(first_fn, first_params, block_fn, layer_params, last_fn,
+        last_params)`` where `block_fn(params, x)` runs ONE decoder layer
+        functionally (identical math to eager forward via functional_call)
+        and `layer_params` is the per-layer param-dict list. Embedding and
+        norm+head stay outside the pipeline stages (replicated), matching
+        the homogeneous-stage contract."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..jit.functional import buffer_arrays, functional_call, state_arrays
+
+        template = self.llama.layers[0]
+        buffers = dict(buffer_arrays(template))
+        layer_params = [dict(sorted(state_arrays(l).items()))
+                        for l in self.llama.layers]
+
+        def block_fn(params, x):
+            out = functional_call(template, params, Tensor(x),
+                                  buffers=buffers)
+            return out._data
+
+        first_params = {"embed": self.llama.embed_tokens.weight._data}
+
+        def first_fn(p, ids):
+            return jnp.take(p["embed"], ids, axis=0)
+
+        tied = self.lm_head is None
+        norm_layer = self.llama.norm
+        last_params = {"norm": self.llama.norm.weight._data,
+                       "head": (first_params["embed"] if tied
+                                else self.lm_head.weight._data)}
+
+        def last_fn(p, x):
+            # reuse nn.RMSNorm via functional_call so the pipelined math
+            # cannot drift from the eager model's
+            h = functional_call(norm_layer, {"weight": p["norm"]},
+                                Tensor(x))._data
+            if tied:
+                return jnp.einsum("...h,vh->...v", h, p["head"])
+            return jnp.einsum("...h,hv->...v", h, p["head"])
+
+        # NOTE tied embeddings: first_params["embed"] and last_params["head"]
+        # are independent leaves to value_and_grad — the tied weight's total
+        # gradient is g_first["embed"] + g_last["head"].T-free sum (both are
+        # [V, H]); callers (Engine pp path) must combine them.
+        return (first_fn, first_params, block_fn, layer_params, last_fn,
+                last_params)
+
     def flops_per_token(self, seq_len: int) -> float:
         """Model FLOPs per trained token (fwd+bwd), PaLM-appendix accounting:
         6*N_params + 12*L*H*Q*T attention term."""

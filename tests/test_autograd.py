@@ -277,3 +277,26 @@ def test_double_grad_with_grad_outputs_on_tape():
     # g1 = 2 x s; d(g1.sum())/ds = 2x
     (gs,) = paddle.grad(g1.sum(), [s])
     np.testing.assert_allclose(np.asarray(gs._data), [2.0, 4.0], rtol=1e-6)
+
+
+def test_hessian_cross_blocks():
+    """Full block Hessian: cross d2y/dxdw blocks included."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.autograd import hessian
+
+    xv = np.asarray([0.5, -1.0])
+    wv = np.asarray([2.0, 3.0])
+    x = paddle.Tensor(xv.copy()); x.stop_gradient = False
+    w = paddle.Tensor(wv.copy()); w.stop_gradient = False
+    y = ((x * w) ** 2).sum()
+    H = hessian(y, [x, w])
+    jf = lambda a, b: ((a * b) ** 2).sum()
+    ref = jax.hessian(jf, argnums=(0, 1))(jnp.asarray(xv), jnp.asarray(wv))
+    for i in range(2):
+        for j in range(2):
+            np.testing.assert_allclose(np.asarray(H[i][j]._data),
+                                       np.asarray(ref[i][j]), rtol=1e-6,
+                                       err_msg=f"block {i}{j}")
+    with pytest.raises(NotImplementedError):
+        hessian(y, x, batch_axis=0)

@@ -402,3 +402,128 @@ def test_scan_pipeline_compiled(pp4):
     ref = jnp.stack(ref)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_zbh1_bubble_below_1f1b():
+    """ZBH1 splits B into dgrad (BX) + wgrad (BW); wgrads fill the warmup/
+    cooldown bubbles so the measured bubble drops below 1F1B's (reference
+    pipeline_zero_bubble.py:61)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        build_schedule, bubble_fraction)
+
+    for S, M in [(2, 8), (4, 8), (4, 16)]:
+        b1 = bubble_fraction(build_schedule("1F1B", S, M), S)
+        bz = bubble_fraction(build_schedule("ZBH1", S, M), S)
+        assert bz < b1, f"S={S} M={M}: ZBH1 {bz} !< 1F1B {b1}"
+    # schedule is complete and dependency-correct: every op appears M times
+    slots = build_schedule("ZBH1", 4, 8)
+    items = [it for s in slots for it in s]
+    for op, count in (("F", 32), ("BX", 32), ("BW", 32)):
+        assert sum(1 for it in items if it[3] == op) == count
+
+
+def test_vpp_single_scan_interleaves(pp4):
+    """Compiled VPP runs all V chunks inside ONE scan: tick count (and so
+    the bubble) beats both V sequential scans and 1F1B at equal work."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        pipeline_ticks, scan_pipeline)
+
+    S, V, M, mb, h = 4, 2, 8, 2, 8
+    # V*M units of work in V*M + S - 1 ticks: bubble < 1F1B's (S-1)/(M+S-1)
+    ticks_vpp = pipeline_ticks(S, M, V)
+    assert ticks_vpp == V * M + S - 1
+    bubble_vpp = 1 - (V * M) / ticks_vpp
+    bubble_1f1b = 1 - M / pipeline_ticks(S, M, 1)
+    assert bubble_vpp < bubble_1f1b
+    assert ticks_vpp < V * (M + S - 1)  # < V chained scans
+
+    # numerics: 8 virtual stages (V=2 chunks x S=4 stages) of y = tanh(xW)
+    rng = np.random.default_rng(0)
+    Ws = jnp.asarray(rng.standard_normal((S, V, h, h)) * 0.3, jnp.float32)
+    xs = jnp.asarray(rng.standard_normal((M, mb, h)), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p)
+
+    out = scan_pipeline(stage_fn, Ws, xs, M, n_chunks=V)
+    ref = xs
+    for c in range(V):
+        for s in range(S):
+            ref = jnp.tanh(ref @ Ws[s, c])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_llama_through_compiled_pipeline(pp4):
+    """The in-tree Llama decoder stack through pipeline_train_step: loss and
+    per-layer grads match the unpipelined eager model (the VERDICT
+    real-model gate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        pipeline_train_step)
+    from paddle_tpu.models.llama import llama_tiny
+
+    paddle.seed(3)
+    S, M, mb, seq = 4, 4, 2, 16
+    model = llama_tiny(vocab=64, layers=4, hidden=32, heads=4, seq=seq)
+    model.eval()
+    (first_fn, first_params, block_fn, layer_params, last_fn,
+     last_params) = model.pipeline_parts()
+    L = len(layer_params)
+    lps = L // S
+    # stack per-stage params: leaves [S, layers_per_stage, ...]
+    keys = sorted(layer_params[0])
+    stacked = {k: jnp.stack([jnp.stack([layer_params[s * lps + l][k]
+                                        for l in range(lps)])
+                             for s in range(S)]) for k in keys}
+
+    def stage_fn(params, x):
+        for l in range(lps):
+            x = block_fn({k: params[k][l] for k in keys}, x)
+        return x
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, size=(M * mb, seq)).astype(np.int64)
+    labels = rng.integers(0, 64, size=(M * mb, seq)).astype(np.int64)
+
+    def loss_fn(logits, labels):
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[..., None], -1)[..., 0]
+        return (lse - picked).mean()
+
+    loss, (g_stacked, g_first, g_last) = pipeline_train_step(
+        stage_fn, stacked, jnp.asarray(ids), jnp.asarray(labels),
+        loss_fn=loss_fn, n_micro=M, schedule="1F1B",
+        first_fn=first_fn, first_params=first_params,
+        last_fn=last_fn, last_params=last_params)
+
+    # eager reference on the same weights
+    ref_loss, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(labels))
+    np.testing.assert_allclose(float(loss), float(ref_loss._data),
+                               rtol=2e-5)
+
+    model.train()
+    loss2, _ = model(paddle.Tensor(ids), labels=paddle.Tensor(labels))
+    loss2.backward()
+    # compare a q_proj grad per layer against the stacked pipeline grads
+    qkey = [k for k in keys if "q_proj" in k][0]
+    for layer_idx in range(L):
+        s, l = divmod(layer_idx, lps)
+        ref_g = np.asarray(
+            model.llama.layers[layer_idx].self_attn.q_proj.weight.grad._data)
+        got = np.asarray(g_stacked[qkey][s, l])
+        np.testing.assert_allclose(got, ref_g, rtol=1e-4, atol=1e-6,
+                                   err_msg=f"layer {layer_idx}")
+    # embedding + head grads flow too
+    ref_embed_g = np.asarray(model.llama.embed_tokens.weight.grad._data)
+    np.testing.assert_allclose(np.asarray(g_first["embed"]), ref_embed_g,
+                               rtol=1e-4, atol=1e-6)
+    ref_head_g = np.asarray(model.lm_head.weight.grad._data)
+    np.testing.assert_allclose(np.asarray(g_last["head"]), ref_head_g,
+                               rtol=1e-4, atol=1e-6)
