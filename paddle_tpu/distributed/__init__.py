@@ -5,7 +5,8 @@ meshes map to `jax.sharding.Mesh`, DistTensors are GSPMD-sharded global
 arrays, eager collectives are jitted XLA programs over ICI/DCN, rendezvous is
 the JAX coordination service.
 """
-from . import auto_parallel  # noqa: F401
+from . import auto_parallel
+from .auto_parallel import Engine, Strategy  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import fleet, sharding  # noqa: F401

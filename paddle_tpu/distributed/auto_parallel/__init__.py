@@ -11,3 +11,8 @@ __all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
            "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
            "ShardingStage1", "ShardingStage2", "ShardingStage3",
            "shard_dataloader", "ShardDataloader", "get_mesh", "set_mesh"]
+
+from .engine import Engine  # noqa: E402
+from .strategy import Strategy  # noqa: E402
+
+__all__ += ["Engine", "Strategy"]

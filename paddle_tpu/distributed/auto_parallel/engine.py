@@ -1,0 +1,581 @@
+"""Auto-parallel static Engine (reference
+`python/paddle/distributed/auto_parallel/static/engine.py:98`).
+
+The reference Engine turns a dygraph model + loss + optimizer + Strategy
+into per-rank PIR programs via mix2dist / sharding-propagation / partition /
+reshard passes executed by PirInterpreter. The TPU-native Engine does the
+same composition as ONE jitted SPMD program over the hybrid
+`jax.sharding.Mesh`:
+
+- dp / mp / sp: parameters keep their semi-auto annotations
+  (`shard_tensor` DistMeta -> NamedSharding); data shards over the `dp`
+  axis; GSPMD inserts every collective (the completion+partition+reshard
+  passes collapse into XLA, SURVEY.md §7.1).
+- pp: when `strategy.pipeline.enable`, models exposing `pipeline_parts()`
+  (e.g. the in-tree Llama) run through the compiled ppermute pipeline
+  (`scan_pipeline` — pp manual, dp/mp GSPMD-auto inside), with the
+  FThenB/1F1B/VPP schedule choice from the strategy.
+- sharding (ZeRO): optimizer state (and stage-3 master params) sharded
+  over dp via output shardings.
+- amp: bf16 compute with f32 master weights in the optimizer state.
+- recompute: per-block remat (`jax.checkpoint`) in the pipeline stage /
+  model remat hook.
+
+fit/evaluate/predict drive the compiled steps; save/load integrate the
+distributed checkpoint (`distributed/checkpoint/save_state_dict.py`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .strategy import Strategy
+
+__all__ = ["Engine", "Strategy"]
+
+
+def _functional_optimizer(opt):
+    """Extract a pure (init, update) pair from an eager optimizer object.
+
+    The Engine's step is one XLA program, so the update must be functional
+    — the analog of the reference's optimizer ops inside the static
+    program."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...optimizer.optimizer import (SGD, Adam, AdamW, Momentum,
+                                        _L2DecayLike)
+
+    if opt is None:
+        return None, None
+    if type(opt) not in (SGD, Adam, AdamW, Momentum):
+        raise NotImplementedError(
+            f"Engine supports SGD/Momentum/Adam/AdamW; got "
+            f"{type(opt).__name__} (its update rule would be silently "
+            "wrong under the functional rewrite)")
+    wd = _L2DecayLike.coeff_of(getattr(opt, "_weight_decay", None))
+    clip = getattr(opt, "_grad_clip", None)
+    clip_norm = None
+    if clip is not None:
+        cn = getattr(clip, "clip_norm", getattr(clip, "_clip_norm", None))
+        if cn is None:
+            raise NotImplementedError(
+                f"Engine supports ClipGradByGlobalNorm only; got "
+                f"{type(clip).__name__}")
+        clip_norm = float(cn)
+
+    def _clip_grads(grads):
+        if clip_norm is None:
+            return grads
+        import jax
+
+        sq = jax.tree.reduce(
+            lambda a, g: a + jnp.sum(g.astype(jnp.float32) ** 2),
+            grads, jnp.zeros((), jnp.float32))
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: (g.astype(jnp.float32)
+                                       * scale).astype(g.dtype), grads)
+
+    if isinstance(opt, (Adam, AdamW)):
+        b1, b2, eps = opt._beta1, opt._beta2, opt._epsilon
+        decoupled = getattr(opt, "_wd_mode", "") == "decoupled"
+
+        def init(params):
+            z = lambda p: jnp.zeros(p.shape, jnp.float32)
+            return {"m": jax.tree.map(z, params),
+                    "v": jax.tree.map(z, params),
+                    "t": jnp.zeros((), jnp.float32)}
+
+        def update(params, grads, state, lr):
+            grads = _clip_grads(grads)
+            t = state["t"] + 1.0
+            b1p, b2p = b1 ** t, b2 ** t
+
+            def upd(p, g, m, v):
+                gf = g.astype(jnp.float32)
+                if wd and not decoupled:
+                    gf = gf + wd * p.astype(jnp.float32)
+                m2 = b1 * m + (1 - b1) * gf
+                v2 = b2 * v + (1 - b2) * gf * gf
+                step = lr * (m2 / (1 - b1p)) / (
+                    jnp.sqrt(v2 / (1 - b2p)) + eps)
+                pf = p.astype(jnp.float32)
+                if wd and decoupled:
+                    pf = pf - lr * wd * pf
+                return (pf - step).astype(p.dtype), m2, v2
+
+            # three passes keep arbitrary param pytrees safe (tuples may
+            # be internal nodes); XLA CSE merges the repeated math
+            new_p = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[0],
+                                 params, grads, state["m"], state["v"])
+            new_m = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[1],
+                                 params, grads, state["m"], state["v"])
+            new_v = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[2],
+                                 params, grads, state["m"], state["v"])
+            return new_p, {"m": new_m, "v": new_v, "t": t}
+
+        return init, update
+
+    if isinstance(opt, Momentum):
+        mu = opt._momentum
+        nesterov = bool(getattr(opt, "_use_nesterov", False))
+
+        def init(params):
+            return {"vel": jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                "t": jnp.zeros((), jnp.float32)}
+
+        def update(params, grads, state, lr):
+            grads = _clip_grads(grads)
+
+            def upd(p, g, v):
+                gf = g.astype(jnp.float32)
+                if wd:
+                    gf = gf + wd * p.astype(jnp.float32)
+                v2 = mu * v + gf
+                step = gf + mu * v2 if nesterov else v2
+                return (p.astype(jnp.float32) - lr * step).astype(p.dtype), v2
+
+            new_p = jax.tree.map(lambda p, g, v: upd(p, g, v)[0],
+                                 params, grads, state["vel"])
+            new_v = jax.tree.map(lambda p, g, v: upd(p, g, v)[1],
+                                 params, grads, state["vel"])
+            return new_p, {"vel": new_v, "t": state["t"] + 1.0}
+
+        return init, update
+
+    # SGD / fallback
+    def init(params):
+        return {"t": jnp.zeros((), jnp.float32)}
+
+    def update(params, grads, state, lr):
+        grads = _clip_grads(grads)
+
+        def upd(p, g):
+            gf = g.astype(jnp.float32)
+            if wd:
+                gf = gf + wd * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - lr * gf).astype(p.dtype)
+
+        return (jax.tree.map(upd, params, grads),
+                {"t": state["t"] + 1.0})
+
+    return init, update
+
+
+class Engine:
+    """`Engine(model, loss, optimizer, strategy).fit(...)` — the compiled
+    auto-parallel trainer (reference engine.py:98, fit :1433)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None,
+                 mesh=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = opt = optimizer
+        self._metrics = metrics
+        self._strategy = strategy or Strategy()
+        self._mesh = mesh          # ProcessMesh (named axes)
+        self._mode = None
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+        self._params = None
+        self._opt_state = None
+        self._pp_parts = None
+        self.history: List[float] = []
+
+    # ------------------------------------------------------------------
+    def _jax_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        if self._mesh is not None:
+            return self._mesh.to_jax_mesh() if hasattr(
+                self._mesh, "to_jax_mesh") else self._mesh
+        from ..fleet.base.topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            return hcg.get_hybrid_mesh().to_jax_mesh()
+        return Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+    def _data_sharding(self, mesh, batch):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if "dp" in mesh.axis_names and batch % mesh.shape["dp"] == 0 \
+                and mesh.shape["dp"] > 1:
+            return NamedSharding(mesh, P("dp"))
+        return NamedSharding(mesh, P())
+
+    def _loss_array(self, out, labels):
+        o = out if isinstance(out, Tensor) else Tensor(out)
+        l = labels if isinstance(labels, Tensor) else Tensor(labels)
+        if self._loss is None:
+            return o._data
+        res = self._loss(o, l)
+        return res._data if isinstance(res, Tensor) else res
+
+    # ------------------------------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Build the compiled SPMD step (shapes specialize on first batch)."""
+        self._mode = mode
+        if self._strategy.gradient_merge.enable:
+            raise NotImplementedError(
+                "gradient_merge: use pipeline.accumulate_steps (pp) or "
+                "larger batches; k-step merge is not wired yet")
+        if self._strategy.sharding.enable and \
+                self._strategy.sharding.stage >= 3:
+            raise NotImplementedError(
+                "sharding stage 3 (param sharding) is not wired in the "
+                "Engine yet; stages 1/2 shard the optimizer state over dp")
+        if self._strategy.pipeline.enable:
+            self._prepare_pp()
+        else:
+            self._prepare_gspmd()
+        return self
+
+    # -- GSPMD (dp/mp/sp + ZeRO) path ----------------------------------
+    def _prepare_gspmd(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ...jit.functional import functional_call, state_arrays
+
+        model = self._model
+        mesh = self._jax_mesh()
+        strat = self._strategy
+        if strat.recompute.enable:
+            for lyr in model.sublayers(include_self=True):
+                if hasattr(lyr, "remat"):
+                    lyr.remat = True
+        params = dict(sorted(state_arrays(model).items()))
+        amp = strat.amp.enable
+        cdtype = jnp.bfloat16 if strat.amp.dtype == "bfloat16" \
+            else jnp.float16
+
+        def loss_fn(params, ids, labels):
+            if amp:
+                params = jax.tree.map(
+                    lambda p: p.astype(cdtype)
+                    if p.dtype == jnp.float32 else p, params)
+            out = functional_call(model, params, Tensor(ids))
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return self._loss_array(out, Tensor(labels)).astype(jnp.float32)
+
+        opt_init, opt_update = _functional_optimizer(self._optimizer)
+
+        def train_step(params, opt_state, lr, ids, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+            new_p, new_s = opt_update(params, grads, opt_state, lr)
+            return loss, new_p, new_s
+
+        train_mode = self._mode in (None, "train")
+        out_shardings = None
+        zero_sh = None
+        if strat.sharding.enable and "dp" in mesh.axis_names \
+                and mesh.shape["dp"] > 1 and opt_init is not None:
+            state_shape = jax.eval_shape(opt_init, params)
+            zero_sh = self._zero_shardings(mesh, state_shape)
+            out_shardings = (None, None, zero_sh)
+        if train_mode:
+            self._train_step = jax.jit(
+                train_step, donate_argnums=(0, 1),
+                out_shardings=out_shardings)
+        self._eval_step = jax.jit(loss_fn)
+
+        def pred(params, ids):
+            out = functional_call(model, params, Tensor(ids))
+            if isinstance(out, (tuple, list)):
+                out = out[0]
+            return out._data if isinstance(out, Tensor) else out
+
+        self._pred_step = jax.jit(pred)
+        self._params = params
+        if opt_init is not None and train_mode:
+            # eval/predict never touch moments: don't allocate 2x f32 state
+            self._opt_state = jax.jit(opt_init,
+                                      out_shardings=zero_sh)(params)
+        self._mesh_cache = mesh
+
+    def _zero_shardings(self, mesh, state_shape):
+        """ZeRO: shard f32 optimizer-state leaves over dp on dim0 when
+        divisible (stage>=1 semantics; GSPMD keeps params replicated) —
+        mapped over the actual opt-state structure."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dp = mesh.shape["dp"]
+
+        def spec_of(p):
+            shape = getattr(p, "shape", ())
+            if len(shape) >= 1 and shape[0] % dp == 0 and shape[0] >= dp:
+                return NamedSharding(mesh, P("dp"))
+            return NamedSharding(mesh, P())
+
+        return jax.tree.map(spec_of, state_shape)
+
+    # -- compiled pipeline path ----------------------------------------
+    def _prepare_pp(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..fleet.meta_parallel.pipeline_parallel import (
+            pipeline_train_step)
+        from .sharding_bridge import partition_spec
+
+        model = self._model
+        if not hasattr(model, "pipeline_parts"):
+            raise TypeError(
+                "strategy.pipeline.enable requires the model to expose "
+                "pipeline_parts() (see models.llama.LlamaForCausalLM)")
+        mesh = self._jax_mesh()
+        if "pp" not in mesh.axis_names or mesh.shape["pp"] < 2:
+            raise ValueError("pipeline strategy needs a mesh with a pp axis")
+        S = mesh.shape["pp"]
+        strat = self._strategy
+        V = max(1, int(strat.pipeline.vpp_degree))
+        M = max(1, int(strat.pipeline.accumulate_steps))
+        schedule = strat.pipeline.schedule_mode
+        (first_fn, first_params, block_fn, layer_params, last_fn,
+         last_params) = model.pipeline_parts()
+        L = len(layer_params)
+        if L % (S * V) != 0:
+            raise ValueError(f"{L} blocks not divisible into {S} stages x "
+                             f"{V} chunks")
+        lps = L // (S * V)
+        keys = sorted(layer_params[0])
+        # layer -> (chunk, stage, slot): stage s, chunk c owns layers
+        # [(c*S+s)*lps, ...) — virtual-stage-contiguous blocks
+        def stack(k):
+            if V > 1:
+                return jnp.stack([
+                    jnp.stack([
+                        jnp.stack([layer_params[(c * S + s) * lps + l][k]
+                                   for l in range(lps)])
+                        for c in range(V)]) for s in range(S)])
+            return jnp.stack([
+                jnp.stack([layer_params[s * lps + l][k]
+                           for l in range(lps)]) for s in range(S)])
+
+        stacked = {k: stack(k) for k in keys}
+        # carry TP/semi-auto annotations: per-key trailing spec from the
+        # template block's DistMeta, prepended with pp + stack dims; models
+        # expose their block modules via pipeline_block_modules()
+        blocks = model.pipeline_block_modules() \
+            if hasattr(model, "pipeline_block_modules") else []
+        named = dict(blocks[0].named_parameters()) if blocks else {}
+        lead = ("pp",) + (None,) * (2 if V > 1 else 1)
+        for k in keys:
+            meta = getattr(named.get(k), "_dist_meta", None)
+            if meta is not None:
+                tail = partition_spec(meta.mesh, meta.placements,
+                                      stacked[k].ndim - len(lead))
+                spec = P(*(lead + tuple(tail)))
+            else:
+                spec = P(*lead)
+            stacked[k] = jax.device_put(stacked[k],
+                                        NamedSharding(mesh, spec))
+        first_params = jax.tree.map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, P())),
+            first_params)
+        last_params = jax.tree.map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, P())),
+            last_params)
+        amp = strat.amp.enable
+        cdtype = jnp.bfloat16
+        tied = getattr(model, "lm_head", True) is None
+
+        if V > 1:
+            # pipeline_train_step expects external chunk-major [V, S, ...]
+            stacked_ext = jax.tree.map(
+                lambda p: jnp.swapaxes(p, 0, 1), stacked)
+        else:
+            stacked_ext = stacked
+
+        def stage_fn(params, x):
+            for l in range(lps):
+                p_l = {k: params[k][l] for k in keys}
+                if amp:
+                    p_l = {k: (v.astype(cdtype)
+                               if v.dtype == jnp.float32 else v)
+                           for k, v in p_l.items()}
+                x = block_fn(p_l, x)
+            return x
+
+        def loss_arr(logits, labels):
+            return self._loss_array(Tensor(logits),
+                                    Tensor(labels)).astype(jnp.float32)
+
+        sched = schedule
+
+        opt_init, opt_update = _functional_optimizer(self._optimizer)
+
+        def train_step(all_params, opt_state, lr, ids, labels):
+            stacked_p, fp, lp = all_params
+            loss, (g_stacked, g_first, g_last) = pipeline_train_step(
+                stage_fn, stacked_p, ids, labels, loss_fn=loss_arr,
+                n_micro=M, schedule=sched, n_chunks=V,
+                first_fn=first_fn, first_params=fp,
+                last_fn=last_fn, last_params=lp, mesh=mesh)
+            if tied:
+                g = g_first["embed"] + g_last["head"]
+                g_first = dict(g_first, embed=g)
+                g_last = dict(g_last, head=g)
+            grads = (g_stacked, g_first, g_last)
+            new_p, new_s = opt_update(all_params, grads, opt_state, lr)
+            return loss, new_p, new_s
+
+        self._params = (stacked_ext, first_params, last_params)
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+        def eval_step(all_params, ids, labels):
+            stacked_p, fp, lp = all_params
+            loss, _ = pipeline_train_step(
+                stage_fn, stacked_p, ids, labels, loss_fn=loss_arr,
+                n_micro=M, schedule=sched, n_chunks=V,
+                first_fn=first_fn, first_params=fp,
+                last_fn=last_fn, last_params=lp, mesh=mesh)
+            return loss
+
+        self._eval_step = jax.jit(eval_step)
+        self._pred_step = None  # pp predict via evaluate-style forward
+        if opt_init is not None:
+            self._opt_state = jax.jit(opt_init)(self._params)
+        self._mesh_cache = mesh
+
+    # ------------------------------------------------------------------
+    def _get_lr(self):
+        import jax.numpy as jnp
+
+        lr = self._optimizer.get_lr() if self._optimizer is not None else 0.0
+        return jnp.asarray(lr, jnp.float32)
+
+    def _place_batch(self, arr):
+        import jax
+
+        mesh = self._mesh_cache
+        a = np.asarray(arr._data if isinstance(arr, Tensor) else arr)
+        return jax.device_put(a, self._data_sharding(mesh, a.shape[0]))
+
+    def fit(self, train_data=None, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1, valid_data=None, collate_fn=None):
+        """Compiled training loop (reference engine.py fit:1433)."""
+        from ... import io
+
+        if self._train_step is None:
+            self.prepare(mode="train")
+        loader = train_data if isinstance(train_data, io.DataLoader) else \
+            io.DataLoader(train_data, batch_size=batch_size, shuffle=False,
+                          collate_fn=collate_fn)
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch and step >= steps_per_epoch:
+                    break
+                ids, labels = batch[0], batch[1]
+                loss, self._params, self._opt_state = self._train_step(
+                    self._params, self._opt_state, self._get_lr(),
+                    self._place_batch(ids), self._place_batch(labels))
+                self.history.append(float(loss))
+                sched = getattr(self._optimizer, "_learning_rate", None)
+                if hasattr(sched, "step"):
+                    sched.step()
+        self._write_back()
+        return self.history
+
+    def evaluate(self, eval_data, batch_size=1, steps=None):
+        from ... import io
+
+        if self._eval_step is None:
+            self.prepare(mode="eval")
+        loader = eval_data if isinstance(eval_data, io.DataLoader) else \
+            io.DataLoader(eval_data, batch_size=batch_size, shuffle=False)
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps and i >= steps:
+                break
+            ids, labels = batch[0], batch[1]
+            losses.append(float(self._eval_step(
+                self._params, self._place_batch(ids),
+                self._place_batch(labels))))
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, batch_size=1, steps=None):
+        from ... import io
+
+        if self._strategy.pipeline.enable:
+            raise NotImplementedError(
+                "predict under pipeline parallelism: use evaluate/fit, or "
+                "the inference engine for serving")
+        if self._pred_step is None:
+            self.prepare(mode="predict")
+        loader = test_data if isinstance(test_data, io.DataLoader) else \
+            io.DataLoader(test_data, batch_size=batch_size, shuffle=False)
+        outs = []
+        for i, batch in enumerate(loader):
+            if steps and i >= steps:
+                break
+            ids = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(np.asarray(self._pred_step(
+                self._params, self._place_batch(ids))))
+        return outs
+
+    # ------------------------------------------------------------------
+    def _write_back(self):
+        """Sync trained arrays back into the eager model's Tensors."""
+        if self._strategy.pipeline.enable:
+            return  # stacked layout; model sync via save/load
+        for name, p in self._model.named_parameters():
+            if name in self._params:
+                p._data = self._params[name]
+
+    def save(self, path: str):
+        """Distributed sharded checkpoint of params + optimizer state."""
+        from ..checkpoint.save_state_dict import save_state_dict
+
+        flat = _flatten_state({"params": self._params,
+                               "opt": self._opt_state or {}})
+        save_state_dict({k: Tensor(v) for k, v in flat.items()}, path)
+
+    def load(self, path: str):
+        from ..checkpoint.load_state_dict import load_state_dict
+
+        state = {"params": self._params, "opt": self._opt_state or {}}
+        flat = _flatten_state(state)
+        target = {k: Tensor(v) for k, v in flat.items()}
+        load_state_dict(target, path)
+        restored = _unflatten_state(state, {k: t._data for k, t in
+                                            target.items()})
+        self._params = restored["params"]
+        if self._opt_state is not None:
+            self._opt_state = restored["opt"]
+        self._write_back()
+
+
+def _flatten_state(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_state(v, f"{prefix}{k}."))
+    elif isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_state(v, f"{prefix}{i}."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten_state(template, flat, prefix=""):
+    if isinstance(template, dict):
+        return {k: _unflatten_state(v, flat, f"{prefix}{k}.")
+                for k, v in template.items()}
+    if isinstance(template, (tuple, list)):
+        return type(template)(
+            _unflatten_state(v, flat, f"{prefix}{i}.")
+            for i, v in enumerate(template))
+    return flat[prefix[:-1]]

@@ -299,6 +299,11 @@ class LlamaForCausalLM(nn.Layer):
         return (first_fn, first_params, block_fn, layer_params, last_fn,
                 last_params)
 
+    def pipeline_block_modules(self):
+        """The per-block modules behind pipeline_parts() (Engine uses their
+        DistMeta annotations to shard the stacked pipeline weights)."""
+        return list(self.llama.layers)
+
     def flops_per_token(self, seq_len: int) -> float:
         """Model FLOPs per trained token (fwd+bwd), PaLM-appendix accounting:
         6*N_params + 12*L*H*Q*T attention term."""

@@ -1,0 +1,224 @@
+"""Auto-parallel static Engine tests (reference
+`auto_parallel/static/engine.py:98` + `test/auto_parallel/` end-to-end
+Llama pattern): Engine.fit over hybrid meshes with numerics vs
+single-device training."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import io, nn, optimizer
+from paddle_tpu.distributed.auto_parallel.engine import Engine, Strategy
+from paddle_tpu.models.llama import llama_tiny
+
+
+def _ce_loss(logits, labels):
+    """CE over [B, S, V] logits (tracer-safe raw-jnp callable)."""
+    lg = logits._data.astype(jnp.float32)
+    lb = labels._data
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    picked = jnp.take_along_axis(lg, lb[..., None], -1)[..., 0]
+    return paddle.Tensor((lse - picked).mean())
+
+
+class _TokenDataset(io.Dataset):
+    def __init__(self, n=8, batch=None, seq=16, vocab=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.ids = rng.integers(0, vocab, size=(n, seq)).astype(np.int64)
+        self.labels = rng.integers(0, vocab, size=(n, seq)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.ids[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.ids)
+
+
+def _mesh(shape, names):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape),
+                names)
+
+
+def _ref_sgd_losses(model, ds, batch_size, lr, steps):
+    """Single-device eager SGD reference trajectory (taped model loss —
+    same mean-CE math as _ce_loss)."""
+    opt = optimizer.SGD(learning_rate=lr, parameters=model.parameters())
+    losses = []
+    n = len(ds)
+    for step in range(steps):
+        sl = slice((step * batch_size) % n, (step * batch_size) % n + batch_size)
+        ids = paddle.Tensor(ds.ids[sl])
+        labels = paddle.Tensor(ds.labels[sl])
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._data))
+    return losses
+
+
+def test_engine_gspmd_dp_mp_matches_single_device():
+    """Engine.fit over dp2 x mp2 (GSPMD, semi-auto annotations) reproduces
+    the single-device SGD loss trajectory."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import ProcessMesh
+
+    paddle.seed(42)
+    model = llama_tiny(vocab=64, layers=2, hidden=32, heads=4, seq=16)
+    paddle.seed(42)
+    ref_model = llama_tiny(vocab=64, layers=2, hidden=32, heads=4, seq=16)
+
+    mesh2d = ProcessMesh(np.arange(4).reshape(2, 2), ["dp", "mp"])
+    # Megatron TP annotations on the MLP (column then row parallel)
+    from paddle_tpu.distributed.placement import Replicate, Shard
+
+    for layer in model.llama.layers:
+        dist.shard_tensor(layer.mlp.gate_proj.weight, mesh2d,
+                          [Replicate(), Shard(1)])
+        dist.shard_tensor(layer.mlp.up_proj.weight, mesh2d,
+                          [Replicate(), Shard(1)])
+        dist.shard_tensor(layer.mlp.down_proj.weight, mesh2d,
+                          [Replicate(), Shard(0)])
+
+    ds = _TokenDataset(n=8, seq=16)
+    eng = Engine(model=model,
+                 loss=_ce_loss,
+                 optimizer=optimizer.SGD(learning_rate=0.1,
+                                         parameters=model.parameters()),
+                 mesh=_mesh((2, 2), ("dp", "mp")))
+    history = eng.fit(ds, epochs=2, batch_size=4)
+
+    ref = _ref_sgd_losses(ref_model, ds, 4, 0.1, 4)
+    np.testing.assert_allclose(history, ref, rtol=1e-4, atol=1e-5)
+    # trained weights synced back into the eager model
+    got = np.asarray(model.llama.layers[0].mlp.gate_proj.weight._data)
+    want = np.asarray(ref_model.llama.layers[0].mlp.gate_proj.weight._data)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_dp_mp_pp_llama():
+    """The VERDICT gate: Llama via Engine.fit with dp2 x mp2 x pp2 on the
+    8-device mesh, loss trajectory vs single-device."""
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.distributed.placement import Replicate, Shard
+    from paddle_tpu import distributed as dist
+
+    paddle.seed(7)
+    model = llama_tiny(vocab=64, layers=4, hidden=32, heads=4, seq=16)
+    paddle.seed(7)
+    ref_model = llama_tiny(vocab=64, layers=4, hidden=32, heads=4, seq=16)
+
+    # TP annotations referencing the pp/dp/mp mesh (per-layer weights)
+    mesh3d = ProcessMesh(np.arange(8).reshape(2, 2, 2), ["pp", "dp", "mp"])
+    for layer in model.llama.layers:
+        dist.shard_tensor(layer.mlp.gate_proj.weight, mesh3d,
+                          [Replicate(), Replicate(), Shard(1)])
+        dist.shard_tensor(layer.mlp.down_proj.weight, mesh3d,
+                          [Replicate(), Replicate(), Shard(0)])
+
+    strategy = Strategy({"pipeline": {"enable": True,
+                                      "schedule_mode": "1F1B",
+                                      "accumulate_steps": 2}})
+    eng = Engine(model=model, loss=_ce_loss,
+                 optimizer=optimizer.SGD(learning_rate=0.1,
+                                         parameters=model.parameters()),
+                 strategy=strategy,
+                 mesh=_mesh((2, 2, 2), ("pp", "dp", "mp")))
+    ds = _TokenDataset(n=8, seq=16)
+    history = eng.fit(ds, epochs=2, batch_size=4)
+
+    ref = _ref_sgd_losses(ref_model, ds, 4, 0.1, 4)
+    np.testing.assert_allclose(history, ref, rtol=2e-4, atol=1e-4)
+
+    # evaluate path shares the compiled program
+    logs = eng.evaluate(ds, batch_size=4)
+    assert np.isfinite(logs["loss"])
+
+
+def test_engine_zero_sharding_and_amp():
+    """strategy.sharding shards Adam moments over dp; amp runs bf16 compute
+    with f32 master math and still converges."""
+    paddle.seed(0)
+    model = llama_tiny(vocab=32, layers=2, hidden=32, heads=4, seq=8)
+    strategy = Strategy({"sharding": {"enable": True, "stage": 1},
+                         "amp": {"enable": True, "dtype": "bfloat16"}})
+    eng = Engine(model=model, loss=_ce_loss,
+                 optimizer=optimizer.AdamW(learning_rate=0.01,
+                                           parameters=model.parameters()),
+                 strategy=strategy, mesh=_mesh((8,), ("dp",)))
+    ds = _TokenDataset(n=16, seq=8, vocab=32)
+    history = eng.fit(ds, epochs=3, batch_size=8)
+    assert history[-1] < history[0]  # learning under bf16+ZeRO
+    # moments actually sharded over dp: per-shard dim0 < global dim0
+    m_tree = eng._opt_state["m"]
+    leaf = m_tree[sorted(m_tree.keys())[0]]
+    embed_m = m_tree["llama.embed_tokens.weight"]
+    shard_shape = embed_m.sharding.shard_shape(embed_m.shape)
+    assert shard_shape[0] == embed_m.shape[0] // 8
+
+
+def test_engine_save_load_roundtrip(tmp_path):
+    paddle.seed(1)
+    model = llama_tiny(vocab=32, layers=2, hidden=32, heads=4, seq=8)
+    eng = Engine(model=model, loss=_ce_loss,
+                 optimizer=optimizer.SGD(learning_rate=0.05,
+                                         parameters=model.parameters()),
+                 mesh=_mesh((2,), ("dp",)))
+    ds = _TokenDataset(n=8, seq=8, vocab=32)
+    eng.fit(ds, epochs=1, batch_size=4)
+    path = str(tmp_path / "engine_ckpt")
+    eng.save(path)
+
+    paddle.seed(1)
+    model2 = llama_tiny(vocab=32, layers=2, hidden=32, heads=4, seq=8)
+    eng2 = Engine(model=model2, loss=_ce_loss,
+                  optimizer=optimizer.SGD(learning_rate=0.05,
+                                          parameters=model2.parameters()),
+                  mesh=_mesh((2,), ("dp",)))
+    eng2.prepare()
+    eng2.load(path)
+    k = "llama.embed_tokens.weight"
+    np.testing.assert_allclose(np.asarray(eng2._params[k]),
+                               np.asarray(eng._params[k]), atol=1e-7)
+
+
+def test_engine_rejects_unsupported_config():
+    paddle.seed(0)
+    model = llama_tiny(vocab=32, layers=2, hidden=32, heads=4, seq=8)
+    with pytest.raises(NotImplementedError):
+        Engine(model=model, loss=_ce_loss,
+               optimizer=optimizer.RMSProp(learning_rate=0.01,
+                                           parameters=model.parameters()),
+               mesh=_mesh((2,), ("dp",))).prepare()
+    eng = Engine(model=model, loss=_ce_loss,
+                 strategy=Strategy({"gradient_merge": {"enable": True}}),
+                 optimizer=optimizer.SGD(learning_rate=0.01,
+                                         parameters=model.parameters()),
+                 mesh=_mesh((2,), ("dp",)))
+    with pytest.raises(NotImplementedError):
+        eng.prepare()
+
+
+def test_engine_grad_clip_applied():
+    """ClipGradByGlobalNorm is honored in the compiled step: with a tiny
+    clip norm the first update moves parameters by at most lr*clip."""
+    paddle.seed(0)
+    model = llama_tiny(vocab=32, layers=1, hidden=32, heads=4, seq=8)
+    clip = nn.ClipGradByGlobalNorm(1e-3)
+    eng = Engine(model=model, loss=_ce_loss,
+                 optimizer=optimizer.SGD(learning_rate=1.0,
+                                         parameters=model.parameters(),
+                                         grad_clip=clip),
+                 mesh=_mesh((2,), ("dp",)))
+    before = {k: np.asarray(v) for k, v in
+              __import__("paddle_tpu").jit.state_arrays(model).items()}
+    ds = _TokenDataset(n=4, seq=8, vocab=32)
+    eng.fit(ds, epochs=1, batch_size=4)
+    total = 0.0
+    for k, v in eng._params.items():
+        total += float(np.sum((np.asarray(v) - before[k]) ** 2))
+    assert np.sqrt(total) <= 1e-3 * 1.0 + 1e-6  # ||delta|| <= lr * clip
