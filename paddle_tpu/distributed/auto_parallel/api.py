@@ -262,8 +262,15 @@ class _ShardingStageBase:
 
     def _axis(self, mesh: ProcessMesh):
         if self._dim is None:
-            return 0 if mesh.ndim == 1 else mesh.dim_names.index("dp") \
-                if "dp" in mesh.dim_names else 0
+            if mesh.ndim == 1:
+                return 0
+            # prefer a real (size>1) sharding-capable axis: the dedicated
+            # "sharding" axis first, then dp
+            for cand in ("sharding", "dp"):
+                if cand in mesh.dim_names and \
+                        mesh.shape[mesh.dim_names.index(cand)] > 1:
+                    return mesh.dim_names.index(cand)
+            return 0
         if isinstance(self._dim, str):
             return mesh.dim_names.index(self._dim)
         return self._dim
@@ -336,6 +343,28 @@ class _ShardedOptimizer:
             return acc
 
         optimizer._init_acc = sharded_init
+
+    def step(self):
+        """Inner step, then the ZeRO-1/2 post-update param all-gather:
+        GSPMD propagation can leave updated params sharded like the
+        accumulators; stages 1/2 keep full params on every device (the
+        reference's broadcast after the sharded update), so un-annotated
+        params are re-replicated. Stage 3 keeps them sharded."""
+        self._inner.step()
+        if self._shard_fn is None or getattr(self._shard_fn, "shard_param",
+                                             False):
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        for p in self._inner._params:
+            if not isinstance(p, Tensor) or \
+                    getattr(p, "_dist_meta", None) is not None:
+                continue
+            sh = getattr(p._data, "sharding", None)
+            if sh is not None and not sh.is_fully_replicated:
+                p._data = jax.device_put(p._data,
+                                         NamedSharding(sh.mesh, P()))
 
     def __getattr__(self, item):
         return getattr(self._inner, item)

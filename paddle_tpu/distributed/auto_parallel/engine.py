@@ -36,132 +36,101 @@ from .strategy import Strategy
 __all__ = ["Engine", "Strategy"]
 
 
-def _functional_optimizer(opt):
-    """Extract a pure (init, update) pair from an eager optimizer object.
+def _functional_optimizer(opt, named_params=None):
+    """Build a pure (init, update) pair ON TOP of the eager optimizer's own
+    hooks — `_init_acc`, `_update_one`, `_wd_of`, `_lr_mult_of` — so the
+    compiled step and eager training share one implementation of every
+    update rule (bias correction, nesterov, decoupled/l1/l2 decay,
+    per-param decay exclusions, lr multipliers).
 
-    The Engine's step is one XLA program, so the update must be functional
-    — the analog of the reference's optimizer ops inside the static
-    program."""
+    `named_params`: name -> Parameter map used to resolve per-param wd/lr
+    for pytree leaves by (suffix-)matching the leaf path against parameter
+    names."""
+    import types
+
     import jax
     import jax.numpy as jnp
 
-    from ...optimizer.optimizer import (SGD, Adam, AdamW, Momentum,
-                                        _L2DecayLike)
-
     if opt is None:
         return None, None
-    if type(opt) not in (SGD, Adam, AdamW, Momentum):
+    if not hasattr(opt, "_update_one") or not hasattr(opt, "_acc_names"):
         raise NotImplementedError(
-            f"Engine supports SGD/Momentum/Adam/AdamW; got "
-            f"{type(opt).__name__} (its update rule would be silently "
-            "wrong under the functional rewrite)")
-    wd = _L2DecayLike.coeff_of(getattr(opt, "_weight_decay", None))
+            f"Engine needs an optimizer exposing the pure _update_one hook; "
+            f"got {type(opt).__name__}")
     clip = getattr(opt, "_grad_clip", None)
     clip_norm = None
     if clip is not None:
-        cn = getattr(clip, "clip_norm", getattr(clip, "_clip_norm", None))
-        if cn is None:
+        if type(clip).__name__ != "ClipGradByGlobalNorm":
             raise NotImplementedError(
                 f"Engine supports ClipGradByGlobalNorm only; got "
                 f"{type(clip).__name__}")
-        clip_norm = float(cn)
+        clip_norm = float(clip.clip_norm)
 
     def _clip_grads(grads):
         if clip_norm is None:
             return grads
-        import jax
-
         sq = jax.tree.reduce(
             lambda a, g: a + jnp.sum(g.astype(jnp.float32) ** 2),
             grads, jnp.zeros((), jnp.float32))
-        norm = jnp.sqrt(sq)
-        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
         return jax.tree.map(lambda g: (g.astype(jnp.float32)
                                        * scale).astype(g.dtype), grads)
 
-    if isinstance(opt, (Adam, AdamW)):
-        b1, b2, eps = opt._beta1, opt._beta2, opt._epsilon
-        decoupled = getattr(opt, "_wd_mode", "") == "decoupled"
+    named_params = named_params or {}
+    from ...optimizer.optimizer import _L2DecayLike
 
-        def init(params):
-            z = lambda p: jnp.zeros(p.shape, jnp.float32)
-            return {"m": jax.tree.map(z, params),
-                    "v": jax.tree.map(z, params),
-                    "t": jnp.zeros((), jnp.float32)}
+    default_wd = (_L2DecayLike.coeff_of(getattr(opt, "_weight_decay", None)),
+                  getattr(opt, "_wd_mode", "l2"))
 
-        def update(params, grads, state, lr):
-            grads = _clip_grads(grads)
-            t = state["t"] + 1.0
-            b1p, b2p = b1 ** t, b2 ** t
+    def _wd_lr(path):
+        key = ".".join(str(getattr(e, "key", getattr(e, "idx", e)))
+                       for e in path)
+        p = named_params.get(key)
+        if p is None:
+            for n, q in named_params.items():
+                if key.endswith(n) or n.endswith(key):
+                    p = q
+                    break
+        if p is None:
+            return default_wd, 1.0
+        return opt._wd_of(p), opt._lr_mult_of(p)
 
-            def upd(p, g, m, v):
-                gf = g.astype(jnp.float32)
-                if wd and not decoupled:
-                    gf = gf + wd * p.astype(jnp.float32)
-                m2 = b1 * m + (1 - b1) * gf
-                v2 = b2 * v + (1 - b2) * gf * gf
-                step = lr * (m2 / (1 - b1p)) / (
-                    jnp.sqrt(v2 / (1 - b2p)) + eps)
-                pf = p.astype(jnp.float32)
-                if wd and decoupled:
-                    pf = pf - lr * wd * pf
-                return (pf - step).astype(p.dtype), m2, v2
+    acc_names = list(opt._acc_names)
 
-            # three passes keep arbitrary param pytrees safe (tuples may
-            # be internal nodes); XLA CSE merges the repeated math
-            new_p = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[0],
-                                 params, grads, state["m"], state["v"])
-            new_m = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[1],
-                                 params, grads, state["m"], state["v"])
-            new_v = jax.tree.map(lambda p, g, m, v: upd(p, g, m, v)[2],
-                                 params, grads, state["m"], state["v"])
-            return new_p, {"m": new_m, "v": new_v, "t": t}
-
-        return init, update
-
-    if isinstance(opt, Momentum):
-        mu = opt._momentum
-        nesterov = bool(getattr(opt, "_use_nesterov", False))
-
-        def init(params):
-            return {"vel": jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params),
-                "t": jnp.zeros((), jnp.float32)}
-
-        def update(params, grads, state, lr):
-            grads = _clip_grads(grads)
-
-            def upd(p, g, v):
-                gf = g.astype(jnp.float32)
-                if wd:
-                    gf = gf + wd * p.astype(jnp.float32)
-                v2 = mu * v + gf
-                step = gf + mu * v2 if nesterov else v2
-                return (p.astype(jnp.float32) - lr * step).astype(p.dtype), v2
-
-            new_p = jax.tree.map(lambda p, g, v: upd(p, g, v)[0],
-                                 params, grads, state["vel"])
-            new_v = jax.tree.map(lambda p, g, v: upd(p, g, v)[1],
-                                 params, grads, state["vel"])
-            return new_p, {"vel": new_v, "t": state["t"] + 1.0}
-
-        return init, update
-
-    # SGD / fallback
     def init(params):
-        return {"t": jnp.zeros((), jnp.float32)}
+        def leaf_accs(a):
+            fake = types.SimpleNamespace(_data=a)
+            return {k: opt._init_acc(k, fake) for k in acc_names}
+
+        return {"accs": jax.tree.map(leaf_accs, params)}
+
+    def _one(path, p, g, a, lr):
+        (wd, kind), lmult = _wd_lr(path)
+        plr = lr if lmult == 1.0 else lr * lmult
+        gg = g.astype(p.dtype)
+        # same decay pre/post handling as Optimizer._build_step_fn
+        if wd and kind == "l2":
+            gg = gg + wd * p
+        elif wd and kind == "l1":
+            gg = gg + wd * jnp.sign(p)
+        elif wd and kind == "decoupled":
+            p = p - plr.astype(p.dtype) * wd * p
+        return opt._update_one(p, gg, a, plr, wd)
 
     def update(params, grads, state, lr):
         grads = _clip_grads(grads)
-
-        def upd(p, g):
-            gf = g.astype(jnp.float32)
-            if wd:
-                gf = gf + wd * p.astype(jnp.float32)
-            return (p.astype(jnp.float32) - lr * gf).astype(p.dtype)
-
-        return (jax.tree.map(upd, params, grads),
-                {"t": state["t"] + 1.0})
+        accs = state["accs"]
+        tu = jax.tree_util
+        is_acc = lambda x: isinstance(x, dict) and set(x) == set(acc_names)
+        # two passes (params then accs) keep arbitrary pytrees safe; XLA
+        # CSE merges the duplicated update math
+        new_p = tu.tree_map_with_path(
+            lambda path, p, g, a: _one(path, p, g, a, lr)[0],
+            params, grads, accs, is_leaf=lambda x: x is None)
+        new_a = tu.tree_map_with_path(
+            lambda path, p, g, a: _one(path, p, g, a, lr)[1],
+            params, grads, accs, is_leaf=lambda x: x is None)
+        return new_p, {"accs": new_a}
 
     return init, update
 
@@ -267,7 +236,8 @@ class Engine:
                 out = out[0]
             return self._loss_array(out, Tensor(labels)).astype(jnp.float32)
 
-        opt_init, opt_update = _functional_optimizer(self._optimizer)
+        opt_init, opt_update = _functional_optimizer(
+            self._optimizer, dict(model.named_parameters()))
 
         def train_step(params, opt_state, lr, ids, labels):
             loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
@@ -387,8 +357,13 @@ class Engine:
         last_params = jax.tree.map(
             lambda p: jax.device_put(p, NamedSharding(mesh, P())),
             last_params)
+        if strat.sharding.enable:
+            raise NotImplementedError(
+                "strategy.sharding under the pipeline path is not wired "
+                "yet; ZeRO out-shardings apply to the GSPMD path only")
         amp = strat.amp.enable
-        cdtype = jnp.bfloat16
+        cdtype = jnp.bfloat16 if strat.amp.dtype == "bfloat16" \
+            else jnp.float16
         tied = getattr(model, "lm_head", True) is None
 
         if V > 1:
@@ -414,7 +389,8 @@ class Engine:
 
         sched = schedule
 
-        opt_init, opt_update = _functional_optimizer(self._optimizer)
+        opt_init, opt_update = _functional_optimizer(
+            self._optimizer, dict(model.named_parameters()))
 
         def train_step(all_params, opt_state, lr, ids, labels):
             stacked_p, fp, lp = all_params

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from .....core.tensor import Tensor
 from ....auto_parallel.api import (ShardingStage1, ShardingStage2,
                                    ShardingStage3, shard_optimizer)
@@ -55,8 +57,14 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
              "p_g_os": ShardingStage3}.get(level)
     if stage is None:
         raise ValueError(f"level must be os/os_g/p_g_os, got {level}")
+    if level == "p_g_os":
+        model = GroupShardedStage3(model, optimizer=optimizer, group=group,
+                                   offload=offload)
+        return model, model.optimizer, scaler
     optimizer = shard_optimizer(optimizer, stage(sharding_mesh_dim=axis),
                                 mesh=mesh)
+    if level == "os_g":
+        model = GroupShardedStage2(model, optimizer, group=group)
     return model, optimizer, scaler
 
 
@@ -74,26 +82,136 @@ def save_group_sharded_model(model, output, optimizer=None):
         save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
 
 
-# class-name parity shims over the same mechanism
+def _shard_ratio(arr) -> float:
+    """per-device shard elements / global elements (1.0 when replicated)."""
+    sh = getattr(arr, "sharding", None)
+    if sh is None or arr.size == 0:
+        return 1.0
+    return float(np.prod(sh.shard_shape(arr.shape))) / float(arr.size)
+
+
 class GroupShardedOptimizerStage2:
-    """reference `group_sharded_optimizer_stage2.py:53`"""
+    """Stage-2 sharded optimizer (reference
+    `group_sharded_optimizer_stage2.py:53`): accumulators (and, inside the
+    jitted step, gradients) live sharded over the sharding axis via GSPMD
+    placements rather than hand-bucketed reduce-scatter."""
 
     def __new__(cls, params, optim, group=None, offload=False, **kw):
-        return shard_optimizer(optim, ShardingStage2(), mesh=_sharding_mesh())
+        if offload:
+            raise NotImplementedError(
+                "CPU offload is not implemented on the TPU path")
+        mesh = _sharding_mesh()
+        if mesh is None:
+            raise RuntimeError("GroupShardedOptimizerStage2 needs "
+                               "fleet.init or a global mesh")
+        return shard_optimizer(
+            optim, ShardingStage2(sharding_mesh_dim=_axis_name(mesh)),
+            mesh=mesh)
 
 
-class GroupShardedStage2:
-    """reference `group_sharded_stage2.py:46` — grads sharded with states."""
+class _GroupShardedBase:
+    """Real wrapper (not a pass-through): delegates forward, exposes and
+    ASSERTS the sharded state. `sharded_state_report()` returns per-tensor
+    (global_bytes, local_bytes) so tests/CI can check the 1/N memory
+    contract."""
 
-    def __new__(cls, layer, sharding_optimizer, group=None, **kw):
-        return layer
+    def __init__(self, layer):
+        self._layer = layer
+
+    def forward(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_layer"], item)
+
+    # -- introspection --------------------------------------------------
+    def param_shard_report(self):
+        out = {}
+        for name, p in self._layer.named_parameters():
+            arr = p._data
+            out[name] = (arr.size * arr.dtype.itemsize, _shard_ratio(arr))
+        return out
+
+    def local_param_fraction(self) -> float:
+        """sum(local param bytes) / sum(global param bytes)."""
+        total, local = 0, 0.0
+        for name, p in self._layer.named_parameters():
+            b = p._data.size * p._data.dtype.itemsize
+            total += b
+            local += b * _shard_ratio(p._data)
+        return local / max(1, total)
 
 
-class GroupShardedStage3:
-    """reference `group_sharded_stage3.py:85` — params sharded too."""
+class GroupShardedStage2(_GroupShardedBase):
+    """reference `group_sharded_stage2.py:46` — optimizer states + grads
+    sharded; params stay replicated. Requires an already-sharded optimizer
+    (GroupShardedOptimizerStage2 / shard_optimizer) and verifies it."""
 
-    def __new__(cls, layer, optimizer=None, group=None, **kw):
+    def __init__(self, layer, sharding_optimizer, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        super().__init__(layer)
+        from ....auto_parallel.api import _ShardedOptimizer
+
+        if not isinstance(sharding_optimizer, _ShardedOptimizer):
+            raise TypeError(
+                "GroupShardedStage2 needs a sharded optimizer (wrap it with "
+                "GroupShardedOptimizerStage2 or dist.shard_optimizer)")
+        self._sharding_optimizer = sharding_optimizer
+
+    def optimizer_state_fraction(self) -> float:
+        """local accumulator bytes / global accumulator bytes (≈ 1/N)."""
+        inner = self._sharding_optimizer._inner
+        total, local = 0, 0.0
+        for accs in inner._accumulators.values():
+            for arr in accs.values():
+                if np.ndim(arr) == 0:
+                    continue
+                b = arr.size * arr.dtype.itemsize
+                total += b
+                local += b * _shard_ratio(arr)
+        return local / max(1, total)
+
+
+class GroupShardedStage3(_GroupShardedBase):
+    """reference `group_sharded_stage3.py:85` — parameters themselves are
+    sharded over the sharding axis at wrap time; GSPMD inserts the
+    gather-on-use all-gathers where weights are consumed (the reference's
+    forward-hook gather/release machinery is XLA's memory planner here)."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_comm=False,
+                 segment_size=2 ** 20, offload=False, **kw):
+        super().__init__(layer)
+        if offload:
+            raise NotImplementedError(
+                "CPU offload is not implemented on the TPU path")
+        mesh = _sharding_mesh()
+        if mesh is None:
+            raise RuntimeError("GroupShardedStage3 needs fleet.init or a "
+                               "global mesh")
+        stage = ShardingStage3(sharding_mesh_dim=_axis_name(mesh))
+        from ....auto_parallel.api import _shard_param_inplace
+
+        n_sharded = 0
+        for p in layer.parameters():
+            if not isinstance(p, Tensor):
+                continue
+            spec = stage._shard_spec_for(list(p.shape), mesh)
+            if spec is not None:
+                _shard_param_inplace(p, mesh, spec)
+                n_sharded += 1
+        if n_sharded == 0:
+            raise ValueError(
+                "no parameter dim0 is divisible by the sharding degree — "
+                "stage 3 would be a no-op")
+        self._mesh = mesh
         if optimizer is not None:
-            shard_optimizer(optimizer, ShardingStage3(),
-                            mesh=_sharding_mesh())
-        return layer
+            self._sharding_optimizer = shard_optimizer(optimizer, stage,
+                                                       mesh=mesh)
+        else:
+            self._sharding_optimizer = None
+
+    @property
+    def optimizer(self):
+        return self._sharding_optimizer

