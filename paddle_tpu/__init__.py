@@ -7,7 +7,33 @@ Distributed: GSPMD over `jax.sharding.Mesh` (dp/mp/pp/sep/sharding/ep axes).
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
+
+# Multi-process rendezvous must happen BEFORE anything initialises the XLA
+# backend, and importing this package touches devices (Tensor machinery), so
+# the launch env contract (PADDLE_MASTER et al., written by
+# `paddle_tpu.distributed.launch`) is honoured at import time — the worker
+# side of SURVEY.md §3.4 step 3.
+if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 \
+        and _os.environ.get("PADDLE_MASTER"):
+    def _coordination_up() -> bool:
+        try:
+            from jax._src import distributed as _jdist
+
+            return _jdist.global_state.client is not None
+        except Exception:
+            return False
+
+    if not _coordination_up():
+        # a rendezvous FAILURE must crash the worker (silently dropping to
+        # single-process would train on divergent weights); only skip when
+        # the service is already up
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["PADDLE_MASTER"],
+            num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
 
 # int64/float64 semantics to match the reference's default dtypes (indices are
 # int64, paddle.arange of ints is int64). Float ops stay float32/bf16 unless the

@@ -12,6 +12,14 @@ over the group axis (a plain replicated tensor means "every rank holds this
 same value", and is auto-broadcast to the stack). This is exactly the
 information content of the reference's one-local-tensor-per-process model,
 expressed as one global array.
+
+Multi-process convention (launch-spawned workers over the coordination
+service): a rank IS a worker process (PADDLE_TRAINER_ID — one process per
+host, all its chips belong to it; unlike the reference's process-per-GPU),
+and collectives run at process granularity through cross-process allgather/
+broadcast primitives guarded by the comm watchdog. Sub-groups (group !=
+None) are a single-controller feature: under multi-process execution they
+raise rather than silently computing from local data.
 """
 from __future__ import annotations
 
@@ -49,6 +57,40 @@ _REDUCERS = {
 
 def _group(group) -> Group:
     return group if group is not None else _get_global_group()
+
+
+def _multiproc() -> bool:
+    """True under real multi-controller execution (launch-spawned workers
+    with a live JAX coordination service)."""
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _mp_broadcast(arr, src: int):
+    """Cross-process broadcast from process `src` (one payload transfer,
+    not a P-way allgather)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from .watchdog import watchdog_guard
+
+    with watchdog_guard("broadcast"):
+        out = multihost_utils.broadcast_one_to_all(
+            np.asarray(arr), is_source=jax.process_index() == src)
+    return jnp.asarray(out)
+
+
+def _mp_allgather(arr):
+    """Cross-process allgather of a process-local value -> np [P, ...]."""
+    from jax.experimental import multihost_utils
+
+    from .watchdog import watchdog_guard
+
+    with watchdog_guard("process_allgather"):
+        return np.asarray(multihost_utils.process_allgather(
+            np.asarray(arr), tiled=False))
 
 
 def _group_sharding(g: Group, ndim_rest: int):
@@ -143,6 +185,11 @@ def _compiled(kind: str, gid: int, shape, dtype, extra):
 
 
 def _run(kind, t: Tensor, group, extra=None, in_place=True):
+    if _multiproc():
+        raise NotImplementedError(
+            f"collective '{kind}' over an explicit sub-group is a "
+            "single-controller feature; under multi-process launch pass "
+            "group=None (process-granularity collectives)")
     g = _group(group)
     stacked, was_stacked = _as_stack(t, g)
     key_shape = tuple(int(s) for s in stacked.shape)
@@ -165,11 +212,29 @@ def _run(kind, t: Tensor, group, extra=None, in_place=True):
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (reference `dist.all_reduce`,
-    `python/paddle/distributed/communication/all_reduce.py`)."""
+    `python/paddle/distributed/communication/all_reduce.py`).
+
+    Multi-process (launch-spawned workers): a true cross-process collective
+    over the coordination service; single-controller: the stacked-array
+    emulation (module docstring)."""
+    if _multiproc() and group is None:
+        import jax.numpy as jnp
+
+        gathered = _mp_allgather(tensor._data)
+        tensor._data = jnp.asarray(_REDUCERS[op](gathered, 0))
+        return _FinishedTask(tensor)
     return _FinishedTask(_run("all_reduce", tensor, group, extra=op))
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    if _multiproc() and group is None:
+        import jax.numpy as jnp
+
+        gathered = _mp_allgather(tensor._data)
+        # every process computes the reduction; only dst's copy is the
+        # contract, extras are replicas (harmless at process granularity)
+        tensor._data = jnp.asarray(_REDUCERS[op](gathered, 0))
+        return _FinishedTask(tensor)
     g = _group(group)
     return _FinishedTask(_run("reduce", tensor, group,
                               extra=(op, g.get_group_rank(dst)
@@ -177,6 +242,11 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    """Broadcast from process `src` (multi-process) / stacked rank (single-
+    controller) — reference `dist.broadcast`."""
+    if _multiproc() and group is None:
+        tensor._data = _mp_broadcast(tensor._data, src)
+        return _FinishedTask(tensor)
     g = _group(group)
     src_local = g.get_group_rank(src)
     return _FinishedTask(_run("broadcast", tensor, group,
@@ -187,6 +257,15 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
                group=None, sync_op=True):
     """Gather per-rank values; fills `tensor_list` with nranks Tensors
     (reference `dist.all_gather`)."""
+    if _multiproc() and group is None:
+        import jax.numpy as jnp
+
+        rows = _mp_allgather(tensor._data)
+        out = [Tensor(jnp.asarray(rows[i])) for i in range(rows.shape[0])]
+        if tensor_list is not None:
+            tensor_list.clear()
+            tensor_list.extend(out)
+        return out
     g = _group(group)
     stacked, _ = _as_stack(tensor, g)
     out = [Tensor(stacked[i]) for i in range(g.nranks)]
@@ -227,6 +306,15 @@ def reduce_scatter(tensor: Tensor, tensor_list=None, op=ReduceOp.SUM,
     (reference `dist.reduce_scatter`)."""
     import jax.numpy as jnp
 
+    if _multiproc() and group is None:
+        import jax
+
+        local = jnp.stack([t._data for t in tensor_list]) \
+            if tensor_list else tensor._data
+        gathered = _mp_allgather(local)          # [P, P, ...chunk]
+        red = _REDUCERS[op](gathered, 0)         # [P, ...chunk]
+        tensor._data = jnp.asarray(red[jax.process_index()])
+        return _FinishedTask(tensor)
     g = _group(group)
     if tensor_list is not None:
         src = Tensor(jnp.stack([t._data for t in tensor_list]))
@@ -256,6 +344,18 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     j. Inputs: list of nranks tensors (the per-destination chunks)."""
     import jax.numpy as jnp
 
+    if _multiproc() and group is None:
+        import jax
+
+        me = jax.process_index()
+        local = jnp.stack([t._data for t in in_tensor_list])   # [P, ...]
+        gathered = _mp_allgather(local)                        # [P, P, ...]
+        result = [Tensor(jnp.asarray(gathered[src, me]))
+                  for src in range(gathered.shape[0])]
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(result)
+        return result
     g = _group(group)
     if isinstance(in_tensor_list, Tensor):
         stacked, _ = _as_stack(in_tensor_list, g)
@@ -293,18 +393,28 @@ _mailbox = {}
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point send. Single-controller: the one process plays every
-    rank, so values queue per group and `recv(src=...)` pops them FIFO
-    regardless of the declared src/dst ranks. In-graph p2p (pipeline stages)
-    uses `ppermute` via `p2p_shift`."""
+    """Point-to-point send. SINGLE-CONTROLLER ONLY: the one process plays
+    every rank, so values queue per group and `recv(src=...)` pops them
+    FIFO regardless of the declared src/dst ranks. Under real multi-process
+    execution this mailbox cannot reach other processes — use the in-graph
+    p2p (`p2p_shift`/ppermute, what pipeline schedules build on) or an
+    object collective instead; calling it there raises."""
     import collections
 
+    if _multiproc():
+        raise NotImplementedError(
+            "eager send/recv is a single-controller mailbox; under "
+            "multi-process launch use p2p_shift (in-graph ppermute) or "
+            "all_gather/broadcast_object_list")
     key = _group(group).id
     _mailbox.setdefault(key, collections.deque()).append(tensor._data)
     return _FinishedTask(tensor)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    if _multiproc():
+        raise NotImplementedError(
+            "eager send/recv is a single-controller mailbox (see send)")
     queue = _mailbox.get(_group(group).id)
     if not queue:
         raise RuntimeError(
@@ -339,16 +449,29 @@ def p2p_shift(tensor: Tensor, offset: int = 1, group=None) -> Tensor:
 
 
 def barrier(group=None):
-    """Block until all outstanding device work is done (the reference's
-    barrier collective over the group)."""
+    """Block until all ranks arrive (reference barrier collective), guarded
+    by the comm watchdog (`watchdog.py`, CommTaskManager analog)."""
     import jax
     import jax.numpy as jnp
 
-    jax.effects_barrier()
-    g = _group(group)
-    jax.block_until_ready(
-        jax.device_put(jnp.zeros(g.nranks),
-                       _group_sharding(g, 0)))
+    from .watchdog import watchdog_guard
+
+    if _multiproc():
+        if group is not None:
+            raise NotImplementedError(
+                "sub-group barrier under multi-process launch is not "
+                "supported; use barrier(group=None)")
+        from jax.experimental import multihost_utils
+
+        with watchdog_guard("barrier"):
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        return _FinishedTask(None)
+    with watchdog_guard("barrier"):
+        jax.effects_barrier()
+        g = _group(group)
+        jax.block_until_ready(
+            jax.device_put(jnp.zeros(g.nranks),
+                           _group_sharding(g, 0)))
     return _FinishedTask(None)
 
 
@@ -362,13 +485,46 @@ def wait(tensor=None, group=None, use_calc_stream=True):
 # -- object collectives ------------------------------------------------------
 
 def all_gather_object(object_list: List, obj, group=None):
-    """Single-controller: every rank's object is this process's object."""
+    """Gather python objects from every rank (reference
+    `dist.all_gather_object`). Multi-process: pickled bytes ride a padded
+    cross-process allgather; single-controller: every rank's object is this
+    process's object."""
     g = _group(group)
     object_list.clear()
+    if _multiproc() and group is None:
+        import pickle
+
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        n = np.asarray([payload.size], np.int64)
+        sizes = _mp_allgather(n)[:, 0]
+        buf = np.zeros(int(sizes.max()), np.uint8)
+        buf[:payload.size] = payload
+        gathered = _mp_allgather(buf)
+        object_list.extend(
+            pickle.loads(gathered[r, :int(sizes[r])].tobytes())
+            for r in range(gathered.shape[0]))
+        return
     object_list.extend([obj] * g.nranks)
 
 
 def broadcast_object_list(object_list: List, src=0, group=None):
+    """Broadcast a python object list from process `src`; only src's list
+    is pickled/shipped (non-src placeholders are never serialized)."""
+    if _multiproc() and group is None:
+        import pickle
+
+        import jax
+
+        me_is_src = jax.process_index() == src
+        payload = np.frombuffer(pickle.dumps(object_list), np.uint8) \
+            if me_is_src else np.zeros(0, np.uint8)
+        size = int(np.asarray(_mp_broadcast(
+            np.asarray([payload.size], np.int64), src))[0])
+        buf = np.zeros(size, np.uint8)
+        if me_is_src:
+            buf[:] = payload
+        data = np.asarray(_mp_broadcast(buf, src))
+        object_list[:] = pickle.loads(data.tobytes())
     return object_list
 
 

@@ -1,0 +1,87 @@
+"""Collective hang watchdog.
+
+Analog of the reference `CommTaskManager`
+(`paddle/phi/core/distributed/comm_task_manager.h:37` + `nccl_comm_task.cc`):
+an async monitor that detects a collective stuck past its timeout, dumps
+diagnostics, and (like the NCCL watchdog) can kill the process so the
+launcher's failure detection / elastic restart takes over
+(`launch/main.py` watcher).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from ...framework import flags
+
+flags.define_flag("comm_timeout_s", 300.0,
+                  "collective watchdog timeout in seconds (0 disables)")
+flags.define_flag("comm_timeout_action", "kill",
+                  "watchdog action on timeout: 'kill' (exit 124, launcher "
+                  "restarts) or 'log'")
+
+__all__ = ["CommWatchdog", "watchdog_guard"]
+
+
+class CommWatchdog:
+    """Monitors one in-flight communication op (CommTask analog)."""
+
+    def __init__(self, op_name: str, timeout: Optional[float] = None,
+                 action: Optional[str] = None):
+        self.op_name = op_name
+        self.timeout = (flags.flag_value("comm_timeout_s")
+                        if timeout is None else float(timeout))
+        self.action = action or flags.flag_value("comm_timeout_action")
+        self._done = threading.Event()
+        self._thread = None
+        self.started_at = None
+
+    def start(self):
+        if not self.timeout or self.timeout <= 0:
+            return self
+        self.started_at = time.time()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def finish(self):
+        self._done.set()
+
+    def _watch(self):
+        if self._done.wait(self.timeout):
+            return
+        elapsed = time.time() - self.started_at
+        rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+        sys.stderr.write(
+            f"[paddle_tpu comm watchdog] rank {rank}: collective "
+            f"'{self.op_name}' stuck for {elapsed:.1f}s "
+            f"(timeout {self.timeout}s). Stacks:\n")
+        for tid, frame in sys._current_frames().items():
+            sys.stderr.write(f"--- thread {tid} ---\n")
+            sys.stderr.write("".join(traceback.format_stack(frame)))
+        sys.stderr.flush()
+        if self.action == "kill":
+            # exit 124 so the launcher's watcher treats it as a failure
+            # and (elastic mode) relaunches — the NCCL-watchdog abort path
+            os._exit(124)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+def watchdog_guard(op_name: str, timeout: Optional[float] = None,
+                   action: Optional[str] = None) -> CommWatchdog:
+    """Context manager guarding one collective call:
+
+    with watchdog_guard("all_reduce"):
+        <blocking collective>
+    """
+    return CommWatchdog(op_name, timeout, action)

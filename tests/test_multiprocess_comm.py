@@ -1,0 +1,167 @@
+"""Multi-process reality check for the eager comm layer (round-2 VERDICT
+item 9): REAL processes spawned through paddle_tpu.distributed.launch,
+cross-process collectives over the JAX coordination service, watchdog kill
+on hang. Mirrors the reference's CommunicationTestDistBase pattern
+(`test/collective/test_communication_api_base.py:28` shelling out to
+`python -m paddle.distributed.launch`)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = '''
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank, world = dist.get_rank(), dist.get_world_size()
+assert world == 2 and jax.process_count() == 2, (world, jax.process_count())
+
+# cross-process all_reduce: sum of (rank+1) over 2 procs = 3
+t = paddle.Tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(np.asarray(t._data), [3.0] * 4)
+
+# max reduction
+t2 = paddle.Tensor(np.asarray([float(rank)], np.float32))
+dist.all_reduce(t2, op=dist.ReduceOp.MAX)
+np.testing.assert_allclose(np.asarray(t2._data), [1.0])
+
+# broadcast from rank 1
+b = paddle.Tensor(np.asarray([float(rank) * 7 + 1], np.float32))
+dist.broadcast(b, src=1)
+np.testing.assert_allclose(np.asarray(b._data), [8.0])
+
+# object collective with ragged payloads
+objs = []
+dist.all_gather_object(objs, {"rank": rank, "pad": "x" * (10 + rank * 50)})
+assert [o["rank"] for o in objs] == [0, 1]
+
+# cross-process all_gather: true per-process values
+gl = []
+dist.all_gather(gl, paddle.Tensor(np.asarray([float(rank)], np.float32)))
+np.testing.assert_allclose([float(np.asarray(g._data)[0]) for g in gl],
+                           [0.0, 1.0])
+
+# reduce_scatter: chunk r of the cross-process sum
+chunks = [paddle.Tensor(np.full((2,), float(rank * 10 + j), np.float32))
+          for j in range(2)]
+out = paddle.Tensor(np.zeros((2,), np.float32))
+dist.reduce_scatter(out, chunks)
+# sum over procs of chunk[rank]: (0*10+r) + (1*10+r) = 10 + 2r
+np.testing.assert_allclose(np.asarray(out._data), [10.0 + 2 * rank] * 2)
+
+# alltoall: receive chunk `rank` from every process
+ins = [paddle.Tensor(np.asarray([float(rank * 10 + j)], np.float32))
+       for j in range(2)]
+outs = []
+dist.alltoall(outs, ins)
+np.testing.assert_allclose(
+    [float(np.asarray(o._data)[0]) for o in outs],
+    [0.0 * 10 + rank, 1.0 * 10 + rank])
+
+# broadcast_object_list ships only src's payload
+olist = [{"from": rank}] if rank == 0 else [None]
+dist.broadcast_object_list(olist, src=0)
+assert olist == [{"from": 0}]
+
+# sub-group collectives must refuse cross-process use (honest gating)
+g2 = dist.new_group([0, 1])
+try:
+    dist.all_reduce(paddle.Tensor(np.ones(2, np.float32)), group=g2)
+    raise SystemExit("subgroup all_reduce should have raised")
+except NotImplementedError:
+    pass
+
+# eager mailbox send/recv must refuse cross-process use
+try:
+    dist.send(t, dst=1 - rank)
+    raise SystemExit("send should have raised")
+except NotImplementedError:
+    pass
+
+dist.barrier()
+print(f"WORKER_OK rank={rank}", flush=True)
+'''
+
+
+@pytest.mark.timeout(300)
+def test_launch_two_process_collectives(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    logs = ""
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for f in logdir.iterdir():
+            logs += f.read_text()
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}\n{logs}"
+    assert "WORKER_OK rank=0" in logs + r.stdout
+    assert "WORKER_OK rank=1" in logs + r.stdout
+
+
+def test_watchdog_kills_hung_collective(tmp_path):
+    """CommTaskManager analog: a collective stuck past the timeout dumps
+    stacks and exits 124 so the launcher's failure detection kicks in."""
+    script = tmp_path / "hang.py"
+    script.write_text('''
+import time
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.framework import flags
+flags.set_flags({"FLAGS_comm_timeout_s": 1.0})
+from paddle_tpu.distributed.communication.watchdog import watchdog_guard
+with watchdog_guard("fake_all_reduce"):
+    time.sleep(30)   # simulated hang
+print("NOT REACHED")
+''')
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=60, cwd=repo, env=env)
+    assert r.returncode == 124
+    assert "stuck" in r.stderr and "fake_all_reduce" in r.stderr
+    assert "NOT REACHED" not in r.stdout
+
+
+def test_watchdog_log_action_does_not_kill(tmp_path):
+    script = tmp_path / "slow.py"
+    script.write_text('''
+import time
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed.communication.watchdog import watchdog_guard
+with watchdog_guard("slow_op", timeout=0.5, action="log"):
+    time.sleep(2)
+print("SURVIVED")
+''')
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=60, cwd=repo, env=env)
+    assert r.returncode == 0
+    assert "SURVIVED" in r.stdout
+    assert "stuck" in r.stderr
