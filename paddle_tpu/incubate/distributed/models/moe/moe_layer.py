@@ -382,8 +382,17 @@ def _ep_local_fn(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, axis_name,
     pos_c = jnp.clip(pos, 0, capacity - 1)
     tok = jnp.tile(jnp.arange(t), top_k)
     xs = x[tok] * keep[:, None].astype(x.dtype)
-    send = jnp.zeros((e_total, capacity, hdim), x.dtype)
-    send = send.at[ti, pos_c].add(xs)
+    # fused dispatch: Pallas scatter into capacity slots when kernels are
+    # on (reference fused_moe_kernel.cu role); XLA scatter otherwise
+    from .....ops.pallas import fused_moe as _fmoe
+
+    slot = jnp.where(keep, pos_c, -1).astype(jnp.int32)
+    if _fmoe.kernels_available():
+        send = _fmoe.moe_dispatch(xs, ti.astype(jnp.int32), slot,
+                                  e_total, capacity)
+    else:
+        send = _fmoe.xla_dispatch(xs, ti.astype(jnp.int32), slot,
+                                  e_total, capacity)
     # exchange: [E, C, H] -> [E/n, n*C, H] (each device keeps its experts,
     # receives every shard's capacity slots for them)
     recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=1,
@@ -398,7 +407,11 @@ def _ep_local_fn(x, gate_w, w1, b1, w2, b2, *, top_k, capacity, axis_name,
     # inverse exchange back to the token owners: [E/n, n*C, H] -> [E, C, H]
     back = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
                               tiled=True)
-    gathered = back[ti, pos_c] * (tv * keep.astype(x.dtype))[:, None]
+    if _fmoe.kernels_available():
+        rows = _fmoe.moe_gather(back, ti.astype(jnp.int32), slot)
+    else:
+        rows = _fmoe.xla_gather(back, ti.astype(jnp.int32), slot)
+    gathered = rows * (tv * keep.astype(x.dtype))[:, None]
     y = gathered.reshape(top_k, t, hdim).sum(axis=0)
     # GShard aux loss on the local shard, averaged over the ep group
     me = probs.mean(axis=0)
