@@ -1,0 +1,14 @@
+"""Auto-tuner: search over parallel configs (reference
+`python/paddle/distributed/auto_tuner/tuner.py:21` + `search.py` /
+`prune.py` / `recorder.py`).
+
+The reference launches one trial JOB per config through
+`paddle.distributed.launch`; on the single-controller TPU stack a trial is
+an in-process compiled Engine step over a resized mesh, so the tuner
+measures real step time per config without process churn. Pruning follows
+the reference's rules: axis degrees must factor the device count, pp must
+divide the layer count, micro-batch must divide the batch.
+"""
+from .tuner import AutoTuner, Recorder, gen_candidates, prune_candidates
+
+__all__ = ["AutoTuner", "Recorder", "gen_candidates", "prune_candidates"]

@@ -1,0 +1,135 @@
+"""AutoTuner implementation (see package docstring)."""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AutoTuner", "Recorder", "gen_candidates", "prune_candidates"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def gen_candidates(tuner_cfg: Dict) -> List[Dict]:
+    """Cartesian grid over dp/mp/pp degrees and micro-batch sizes
+    (reference `search.py GridSearch`)."""
+    n = int(tuner_cfg.get("num_devices", 1))
+    dp = tuner_cfg.get("dp_degree", "auto")
+    mp = tuner_cfg.get("mp_degree", "auto")
+    pp = tuner_cfg.get("pp_degree", "auto")
+    mbs = tuner_cfg.get("micro_batch_size", "auto")
+    batch = int(tuner_cfg.get("global_batch_size", 1))
+    dp_c = _divisors(n) if dp == "auto" else [int(x) for x in np.atleast_1d(dp)]
+    mp_c = _divisors(n) if mp == "auto" else [int(x) for x in np.atleast_1d(mp)]
+    pp_c = _divisors(n) if pp == "auto" else [int(x) for x in np.atleast_1d(pp)]
+    mb_c = _divisors(batch) if mbs == "auto" \
+        else [int(x) for x in np.atleast_1d(mbs)]
+    out = []
+    for d, m, p, mb in itertools.product(dp_c, mp_c, pp_c, mb_c):
+        out.append({"dp_degree": d, "mp_degree": m, "pp_degree": p,
+                    "micro_batch_size": mb})
+    return out
+
+
+def prune_candidates(candidates: List[Dict], tuner_cfg: Dict) -> List[Dict]:
+    """Reference `prune.py` rules: product must equal the device count, pp
+    must divide the model's layer count, micro-batch must divide the
+    per-dp batch."""
+    n = int(tuner_cfg.get("num_devices", 1))
+    layers = int(tuner_cfg.get("num_layers", 0))
+    batch = int(tuner_cfg.get("global_batch_size", 1))
+    keep = []
+    for c in candidates:
+        d, m, p = c["dp_degree"], c["mp_degree"], c["pp_degree"]
+        if d * m * p != n:
+            continue
+        if layers and p > 1 and layers % p != 0:
+            continue
+        if batch % d != 0:
+            continue
+        local = batch // d
+        if local % c["micro_batch_size"] != 0:
+            continue
+        keep.append(c)
+    return keep
+
+
+class Recorder:
+    """Trial history sorted by the metric (reference `recorder.py`)."""
+
+    def __init__(self, metric: str = "step_time", maximize: bool = False):
+        self.metric = metric
+        self.maximize = maximize
+        self.history: List[Dict] = []
+
+    def add(self, cfg: Dict, result: Dict):
+        self.history.append({**cfg, **result})
+
+    def best(self) -> Optional[Dict]:
+        ok = [h for h in self.history if h.get("error") is None]
+        if not ok:
+            return None
+        return (max if self.maximize else min)(
+            ok, key=lambda h: h[self.metric])
+
+    def sorted(self) -> List[Dict]:
+        ok = [h for h in self.history if h.get("error") is None]
+        return sorted(ok, key=lambda h: h[self.metric],
+                      reverse=self.maximize)
+
+
+class AutoTuner:
+    """Search the parallel-config space by timing real trial steps
+    (reference `tuner.py AutoTuner`).
+
+    trial_fn(cfg) -> dict with the metric (e.g. {"step_time": s}) — the
+    caller builds/times an Engine step for the config (in-process trials;
+    the reference launches subprocess jobs). Exceptions are recorded as
+    pruned-by-error, mirroring the reference's failed-trial handling.
+    """
+
+    def __init__(self, tuner_cfg: Dict,
+                 trial_fn: Optional[Callable[[Dict], Dict]] = None):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.trial_fn = trial_fn
+        self.recorder = Recorder(
+            metric=tuner_cfg.get("metric", "step_time"),
+            maximize=bool(tuner_cfg.get("maximize", False)))
+        cands = gen_candidates(self.tuner_cfg)
+        self.candidates = prune_candidates(cands, self.tuner_cfg)
+        self._cur = 0
+
+    def has_next(self) -> bool:
+        return self._cur < len(self.candidates)
+
+    def get_next_cfg(self) -> Optional[Dict]:
+        if not self.has_next():
+            return None
+        cfg = self.candidates[self._cur]
+        self._cur += 1
+        return cfg
+
+    def tune(self, max_trials: Optional[int] = None) -> Optional[Dict]:
+        """Run trials through trial_fn; returns the best config."""
+        if self.trial_fn is None:
+            raise ValueError("pass trial_fn to tune()")
+        n = 0
+        while self.has_next():
+            if max_trials is not None and n >= max_trials:
+                break
+            cfg = self.get_next_cfg()
+            t0 = time.time()
+            try:
+                res = self.trial_fn(cfg)
+                res.setdefault("error", None)
+            except Exception as e:  # failed trial: record and continue
+                res = {self.recorder.metric: float("inf"),
+                       "error": f"{type(e).__name__}: {e}"}
+            res.setdefault("elapsed", time.time() - t0)
+            self.recorder.add(cfg, res)
+            n += 1
+        return self.recorder.best()

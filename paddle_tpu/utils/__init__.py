@@ -1,0 +1,24 @@
+"""paddle_tpu.utils — install check + interop utilities
+(reference `python/paddle/utils/`)."""
+from . import dlpack
+from .dlpack import from_dlpack, to_dlpack
+
+__all__ = ["dlpack", "to_dlpack", "from_dlpack", "run_check"]
+
+
+def run_check():
+    """`paddle.utils.run_check` — verify the install can compute on the
+    available device."""
+    import numpy as np
+
+    from .. import Tensor
+
+    x = Tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).sum()
+    assert float(y._data) == 8.0
+    import jax
+
+    d = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device: "
+          f"{d.platform}:{d.id} ({d.device_kind})")
+    return True

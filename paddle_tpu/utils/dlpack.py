@@ -1,0 +1,32 @@
+"""DLPack interop (reference `paddle/fluid/framework/dlpack_tensor.cc`,
+python surface `paddle.utils.dlpack`).
+
+Zero-copy exchange with torch/numpy/cupy etc. DLPack's modern form passes
+protocol OBJECTS (anything with `__dlpack__`/`__dlpack_device__`) rather
+than raw capsules; jax arrays implement the protocol natively, so
+`to_dlpack` hands out the protocol-bearing array and `from_dlpack` accepts
+any protocol object (torch tensors, numpy arrays, ...).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x: Tensor):
+    """Tensor -> DLPack-protocol object (consumable by
+    `torch.utils.dlpack.from_dlpack`, `np.from_dlpack`, ...)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def from_dlpack(dlpack) -> Tensor:
+    """DLPack-protocol object -> Tensor (zero-copy where devices allow)."""
+    import jax
+
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack expects an object implementing the DLPack "
+            "protocol (__dlpack__/__dlpack_device__); raw PyCapsule "
+            "exchange was removed from the protocol (DLPack >= 0.8)")
+    return Tensor(jax.dlpack.from_dlpack(dlpack), stop_gradient=True)
