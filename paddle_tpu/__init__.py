@@ -46,9 +46,10 @@ from .framework.dtype import (DType, bfloat16, complex64, complex128,  # noqa: E
                               float64, get_default_dtype, int8, int16, int32,
                               int64, set_default_dtype, uint8)
 from .framework.dtype import bool_ as bool  # noqa: E402
-from .framework.place import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: E402
-                              device_count, get_device, is_compiled_with_cuda,
-                              is_compiled_with_tpu, set_device)
+from .framework.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place,  # noqa: E402
+                              TPUPlace, device_count, get_device,
+                              is_compiled_with_cuda, is_compiled_with_tpu,
+                              set_device)
 from .framework.flags import get_flags, set_flags  # noqa: E402
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: E402
 from .core.tensor import Tensor  # noqa: E402
@@ -76,6 +77,29 @@ from .framework.io import load, save  # noqa: E402
 
 if _ilu.find_spec(f"{__name__}.hapi") is not None:
     from .hapi.model import Model, summary  # noqa: E402
+
+# remaining top-level parity surface (reference python/paddle/__init__.py)
+from .nn.parameter import ParamAttr, create_parameter  # noqa: E402
+from .distributed.parallel import DataParallel  # noqa: E402
+from .framework.dtype import DType as dtype  # noqa: E402
+from .utils.flops import flops  # noqa: E402
+
+# CUDA-named RNG state APIs map to the accelerator generator (framework/random.py)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+class LazyGuard:
+    """Compatibility context (reference nn/initializer/lazy_init.py): defers
+    parameter materialization. Under XLA, initializer programs are traced jit
+    functions whose buffers materialize on first device use, so eager Python
+    work inside the guard is already minimal; this guard is a no-op marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
 
 
 def disable_static(*a, **k):

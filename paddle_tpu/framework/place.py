@@ -66,6 +66,14 @@ class CUDAPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class CUDAPinnedPlace(Place):
+    """Pinned host memory place. On TPU, host staging buffers are managed by
+    PJRT; this maps to the host (CPU) side of the transfer."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
 @functools.lru_cache(maxsize=None)
 def _default_accelerator_type() -> str:
     import jax

@@ -18,11 +18,12 @@ from .math import *  # noqa: F401,F403
 from .math import (abs, add, clip, cumprod, cumsum, divide, exp, floor_divide, log,
                    maximum, minimum, multiply, neg, pow, remainder, scale, sqrt,
                    square, subtract, tanh)
-from .comparison import (allclose, bitwise_and, bitwise_not, bitwise_or,
+from .comparison import (allclose, bitwise_and, bitwise_left_shift,
+                         bitwise_not, bitwise_or, bitwise_right_shift,
                          bitwise_xor, equal, equal_all, greater_equal,
-                         greater_than, is_tensor, isclose, less_equal, less_than,
-                         logical_and, logical_not, logical_or, logical_xor,
-                         not_equal)
+                         greater_than, is_empty, is_tensor, isclose,
+                         less_equal, less_than, logical_and, logical_not,
+                         logical_or, logical_xor, not_equal)
 from .reduction import (all, amax, amin, any, argmax, argmin, count_nonzero,
                         logsumexp, max, mean, median, min, nanmean, nanmedian,
                         nansum, prod, quantile, std, sum, var)
@@ -36,6 +37,8 @@ from .linalg import (bincount, bmm, cholesky, cholesky_solve, cond, corrcoef, co
                      histogram, inv, inverse, lstsq, lu, matmul, matrix_power,
                      matrix_rank, matrix_transpose, mm, multi_dot, mv, norm, pinv,
                      qr, slogdet, solve, svd, triangular_solve)
+from . import extended
+from .extended import *  # noqa: F401,F403
 from .manipulation import (as_complex, as_real, argsort, broadcast_shape,
                            broadcast_tensors, broadcast_to, bucketize, cast, chunk,
                            concat, crop, diag_embed, diagonal, expand, expand_as,
@@ -47,6 +50,8 @@ from .manipulation import (as_complex, as_real, argsort, broadcast_shape,
                            sort, split, squeeze, stack, strided_slice, swapaxes,
                            t, take_along_axis, tile, topk, transpose, unbind,
                            unique, unique_consecutive, unsqueeze, unstack, where)
+from .manipulation import (reshape_, select_scatter, squeeze_,  # noqa: F401
+                           unsqueeze_, where_)
 
 # ---------------------------------------------------------------------------
 # Tensor method patching (tensor_patch_methods analog)
@@ -119,7 +124,38 @@ _METHODS = dict(
     slice=manipulation.slice,
     # activations as methods (paddle has some)
     softmax=activation.softmax, relu=activation.relu,
+    # extended coverage (ops/extended.py)
+    trace=extended.trace, take=extended.take, cummax=extended.cummax,
+    cummin=extended.cummin, kthvalue=extended.kthvalue, mode=extended.mode,
+    isin=extended.isin, frexp=extended.frexp, signbit=extended.signbit,
+    sgn=extended.sgn, logit=extended.logit, sinc=extended.sinc,
+    gammaln=extended.gammaln, gammainc=extended.gammainc,
+    gammaincc=extended.gammaincc, multigammaln=extended.multigammaln,
+    polygamma=extended.polygamma, ldexp=extended.ldexp,
+    tensordot=extended.tensordot, renorm=extended.renorm,
+    cdist=extended.cdist, trapezoid=extended.trapezoid,
+    cumulative_trapezoid=extended.cumulative_trapezoid,
+    nanquantile=extended.nanquantile, index_add=extended.index_add,
+    index_fill=extended.index_fill, index_put=extended.index_put,
+    masked_scatter=extended.masked_scatter,
+    select_scatter=manipulation.select_scatter,
+    slice_scatter=extended.slice_scatter,
+    where_=manipulation.where_,
+    diagonal_scatter=extended.diagonal_scatter, unfold=extended.unfold,
+    unflatten=extended.unflatten, view=extended.view, view_as=extended.view_as,
+    as_strided=extended.as_strided, vander=extended.vander,
+    bitwise_left_shift=comparison.bitwise_left_shift,
+    bitwise_right_shift=comparison.bitwise_right_shift,
+    isneginf=extended.isneginf, isposinf=extended.isposinf,
+    isreal=extended.isreal, is_complex=extended.is_complex,
+    is_floating_point=extended.is_floating_point,
+    is_integer=extended.is_integer, is_empty=comparison.is_empty,
+    tolist=extended.tolist, normal_=extended.normal_,
+    log_normal_=extended.log_normal_, cauchy_=extended.cauchy_,
+    geometric_=extended.geometric_, bernoulli_=extended.bernoulli_,
+    exponential_=extended.exponential_, tensor_split=extended.tensor_split,
 )
+_METHODS.update(extended._INPLACE)
 
 for _name, _fn in _METHODS.items():
     setattr(Tensor, _name, _fn)

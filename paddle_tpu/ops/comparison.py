@@ -53,14 +53,25 @@ def bitwise_not(x, name=None):
     return dispatch.apply("bitwise_not", [as_tensor(x)])
 
 
-def bitwise_left_shift(x, y, name=None):
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
     x, y = prep_binary(x, y)
     return dispatch.apply("bitwise_left_shift", [x, y])
 
 
-def bitwise_right_shift(x, y, name=None):
+def _logical_right_shift(x, y):
+    # shift in zeros regardless of sign: reinterpret as unsigned, shift, cast back
+    bits = np.dtype(x.dtype).itemsize * 8
+    ux = x.astype(np.dtype(f"uint{bits}"))
+    return jnp.right_shift(ux, y.astype(ux.dtype)).astype(x.dtype)
+
+
+dispatch.register_op("bitwise_right_shift_logic", _logical_right_shift)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
     x, y = prep_binary(x, y)
-    return dispatch.apply("bitwise_right_shift", [x, y])
+    op = "bitwise_right_shift" if is_arithmetic else "bitwise_right_shift_logic"
+    return dispatch.apply(op, [x, y])
 
 
 dispatch.register_op("isclose", lambda x, y, *, rtol, atol, equal_nan: jnp.isclose(

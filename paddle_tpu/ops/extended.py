@@ -1,0 +1,1097 @@
+"""Extended operator coverage: stacking/splitting families, scatter-by-index
+families, special functions, windowed/strided views, pairwise distances, and
+the remaining `paddle.*` tensor API surface.
+
+Reference: python/paddle/tensor/{math,manipulation,creation,linalg,search}.py —
+these are the pure-Python `_C_ops` wrappers; here each op is a jnp/lax program
+registered in the dispatch cache (SURVEY.md §2.2-2.3: the YAML-op ↔ kernel pair
+collapses to one registered function on TPU).
+"""
+from __future__ import annotations
+
+import itertools
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+from ._helpers import (as_tensor, inplace_rebind, make_binary,
+                       make_float_unary, normalize_axis, prep_binary)
+
+
+def _reg(name, fn, multi_out=False):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn, multi_out=multi_out)
+
+
+def _key_tensor():
+    return random_mod.next_key()
+
+
+# ---------------------------------------------------------------------------
+# stacking / splitting (python/paddle/tensor/manipulation.py: hstack:7040 ff.)
+# ---------------------------------------------------------------------------
+
+def _stack_family(name, jfn):
+    def api(x, name_=None):
+        ts = [as_tensor(t) for t in x]
+        opname = f"{name}_{len(ts)}"
+        _reg(opname, lambda *xs: jfn(xs))
+        return dispatch.apply(opname, ts)
+
+    api.__name__ = name
+    return api
+
+
+hstack = _stack_family("hstack", jnp.hstack)
+vstack = _stack_family("vstack", jnp.vstack)
+dstack = _stack_family("dstack", jnp.dstack)
+column_stack = _stack_family("column_stack", jnp.column_stack)
+row_stack = vstack
+
+
+def _split_family(name, axis_of):
+    def api(x, num_or_indices=None, name_=None):
+        x = as_tensor(x)
+        from .manipulation import split
+
+        axis = axis_of(x)
+        if isinstance(num_or_indices, int):
+            return split(x, num_or_indices, axis=axis)
+        # indices are split points -> section sizes
+        pts = list(num_or_indices)
+        dim = x.shape[axis]
+        bounds = [0] + [int(p) for p in pts] + [dim]
+        sections = [bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)]
+        return split(x, sections, axis=axis)
+
+    api.__name__ = name
+    return api
+
+
+hsplit = _split_family("hsplit", lambda x: 0 if x.ndim == 1 else 1)
+vsplit = _split_family("vsplit", lambda x: 0)
+dsplit = _split_family("dsplit", lambda x: 2)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Like split but allows non-divisible even splits (manipulation.py:tensor_split)."""
+    x = as_tensor(x)
+    axis = normalize_axis(axis, x.ndim)
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sections = [base + 1] * rem + [base] * (n - rem)
+    else:
+        bounds = [0] + [int(p) for p in num_or_indices] + [dim]
+        sections = [max(0, bounds[i + 1] - bounds[i]) for i in range(len(bounds) - 1)]
+    from .manipulation import split
+
+    return split(x, sections, axis=axis)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t = as_tensor(t)
+        _reg("atleast_1d", jnp.atleast_1d)
+        outs.append(dispatch.apply("atleast_1d", [t]))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        _reg("atleast_2d", jnp.atleast_2d)
+        outs.append(dispatch.apply("atleast_2d", [as_tensor(t)]))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        _reg("atleast_3d", jnp.atleast_3d)
+        outs.append(dispatch.apply("atleast_3d", [as_tensor(t)]))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def block_diag(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    opname = f"block_diag_{len(ts)}"
+    _reg(opname, lambda *xs: jax.scipy.linalg.block_diag(*[jnp.atleast_2d(x) for x in xs]))
+    return dispatch.apply(opname, ts)
+
+
+def unflatten(x, axis, shape, name=None):
+    x = as_tensor(x)
+    axis = normalize_axis(axis, x.ndim)
+    shape = tuple(int(s) for s in shape)
+    new_shape = tuple(x.shape[:axis]) + shape + tuple(x.shape[axis + 1:])
+    from .manipulation import reshape
+
+    return reshape(x, new_shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (manipulation.py:unfold — strided view on GPU;
+    a gather on TPU where XLA has no aliasing views)."""
+    x = as_tensor(x)
+    axis = normalize_axis(axis, x.ndim)
+    dim = x.shape[axis]
+    n_win = (dim - size) // step + 1
+    _reg("unfold_axis", lambda x, *, axis, size, step, n_win: _unfold_impl(x, axis, size, step, n_win))
+    return dispatch.apply("unfold_axis", [x],
+                          {"axis": axis, "size": int(size), "step": int(step), "n_win": n_win})
+
+
+def _unfold_impl(x, axis, size, step, n_win):
+    idx = jnp.arange(n_win)[:, None] * step + jnp.arange(size)[None, :]  # [n_win, size]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    shp = x.shape[:axis] + (n_win, size) + x.shape[axis + 1:]
+    out = out.reshape(shp)
+    # paddle appends the window dim at the end
+    perm = list(range(out.ndim))
+    wdim = perm.pop(axis + 1)
+    perm.append(wdim)
+    return out.transpose(perm)
+
+
+def view(x, shape_or_dtype, name=None):
+    x = as_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        from .manipulation import reshape
+
+        return reshape(x, shape_or_dtype)
+    # dtype view: bitcast. Paddle reinterprets the flat buffer and scales the
+    # LAST dim by the itemsize ratio; XLA's bitcast_convert_type instead
+    # appends/consumes a trailing dim, so fold it back explicitly.
+    npd = np.dtype(dtype_mod.to_np(shape_or_dtype))
+
+    def impl(x, *, dtype):
+        dtype = np.dtype(dtype)
+        src = np.dtype(x.dtype).itemsize
+        if dtype.itemsize > src:  # widening: feed XLA [..., n/r, r] to consume
+            r = dtype.itemsize // src
+            x = x.reshape(x.shape[:-1] + (x.shape[-1] // r, r))
+            return jax.lax.bitcast_convert_type(x, dtype)
+        out = jax.lax.bitcast_convert_type(x, dtype)
+        if dtype.itemsize < src:  # narrowing: [..., n, r] -> [..., n*r]
+            return out.reshape(out.shape[:-2] + (out.shape[-2] * out.shape[-1],))
+        return out
+
+    _reg("bitcast_view", impl)
+    return dispatch.apply("bitcast_view", [x], {"dtype": npd.name})
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+
+    return reshape(as_tensor(x), tuple(as_tensor(other).shape))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view over the contiguous buffer (manipulation.py:as_strided).
+    XLA has no aliasing views, so this is an explicit gather on flat indices."""
+    x = as_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    grids = np.indices(shape).reshape(len(shape), -1)
+    flat_idx = offset + sum(g * s for g, s in zip(grids, stride))
+    idx = jnp.asarray(flat_idx)
+    opname = "as_strided_gather"
+    _reg(opname, lambda x, idx, *, shape: jnp.take(x.reshape(-1), idx).reshape(shape))
+    return dispatch.apply(opname, [x, Tensor(idx, stop_gradient=True)], {"shape": shape})
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    _reg("take_flat", lambda x, i, *, mode: jnp.take(
+        x.reshape(-1), i if mode != "wrap" else i % x.size,
+        mode="clip" if mode != "wrap" else None))
+    return dispatch.apply("take_flat", [x, index], {"mode": str(mode)})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    _reg("trace_op", lambda x, *, offset, axis1, axis2: jnp.trace(
+        x, offset=offset, axis1=axis1, axis2=axis2))
+    return dispatch.apply("trace_op", [as_tensor(x)],
+                          {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = as_tensor(x)
+    n = int(n) if n is not None else x.shape[0]
+    _reg("vander_op", lambda x, *, n, increasing: jnp.vander(x, n, increasing=increasing))
+    return dispatch.apply("vander_op", [x], {"n": n, "increasing": bool(increasing)})
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.to_np(dtype)),
+                  stop_gradient=True)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.to_np(dtype)),
+                  stop_gradient=True)
+
+
+def cartesian_prod(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    opname = f"cartesian_prod_{len(ts)}"
+
+    def impl(*xs):
+        grids = jnp.meshgrid(*xs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    _reg(opname, impl)
+    out = dispatch.apply(opname, ts)
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    x = as_tensor(x)
+    n = x.shape[0]
+    combo = (itertools.combinations_with_replacement if with_replacement
+             else itertools.combinations)
+    idx = np.array(list(combo(range(n), r)), dtype=np.int64).reshape(-1, r)
+    _reg("combinations_gather", lambda x, i: jnp.take(x, i.reshape(-1)).reshape(i.shape))
+    return dispatch.apply("combinations_gather",
+                          [x, Tensor(jnp.asarray(idx), stop_gradient=True)])
+
+
+# ---------------------------------------------------------------------------
+# scatter-by-index family (manipulation.py: index_add:5405, index_fill,
+# index_put, *_scatter; phi/kernels/*scatter*)
+# ---------------------------------------------------------------------------
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = as_tensor(x), as_tensor(index), as_tensor(value)
+    axis = normalize_axis(axis, x.ndim)
+    _reg("index_add_op", lambda x, i, v, *, axis: _index_axis_op(x, i, v, axis, "add"))
+    return dispatch.apply("index_add_op", [x, index, value], {"axis": axis})
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = as_tensor(x), as_tensor(index)
+    axis = normalize_axis(axis, x.ndim)
+    if isinstance(value, Tensor):
+        value = float(np.asarray(value.numpy()))
+    _reg("index_fill_op", lambda x, i, *, axis, value: _index_axis_fill(x, i, axis, value))
+    return dispatch.apply("index_fill_op", [x, index], {"axis": axis, "value": float(value)})
+
+
+def _index_axis_op(x, i, v, axis, mode):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = i
+    ref = x.at[tuple(sl)]
+    return ref.add(v) if mode == "add" else ref.set(v)
+
+
+def _index_axis_fill(x, i, axis, value):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = i
+    return x.at[tuple(sl)].set(jnp.asarray(value, dtype=x.dtype))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = as_tensor(x)
+    idx = [as_tensor(i) for i in indices]
+    value = as_tensor(value)
+    opname = f"index_put_{len(idx)}_{bool(accumulate)}"
+
+    def impl(x, v, *ii, accumulate=accumulate):
+        ref = x.at[tuple(ii)]
+        return ref.add(v) if accumulate else ref.set(v)
+
+    _reg(opname, impl)
+    return dispatch.apply(opname, [x, value] + idx)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of `mask` with `value`'s elements in order
+    (manipulation.py:masked_scatter — jittable via cumsum-packing)."""
+    x, mask, value = as_tensor(x), as_tensor(mask), as_tensor(value)
+
+    def impl(x, m, v):
+        m = jnp.broadcast_to(m, x.shape)
+        flat_m = m.reshape(-1)
+        src = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        picked = jnp.take(v.reshape(-1), jnp.clip(src, 0, v.size - 1))
+        return jnp.where(flat_m, picked, x.reshape(-1)).reshape(x.shape)
+
+    _reg("masked_scatter_op", impl)
+    return dispatch.apply("masked_scatter_op", [x, mask, value])
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, value = as_tensor(x), as_tensor(value)
+    key = (tuple(axes), tuple(int(s) for s in starts), tuple(int(e) for e in ends),
+           tuple(int(s) for s in strides))
+
+    def impl(x, v, *, axes, starts, ends, strides):
+        sl = [slice(None)] * x.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            sl[a] = slice(s, e, st)
+        return x.at[tuple(sl)].set(v)
+
+    _reg("slice_scatter_op", impl)
+    return dispatch.apply("slice_scatter_op", [x, value],
+                          {"axes": key[0], "starts": key[1], "ends": key[2], "strides": key[3]})
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def impl(x, y, *, offset, axis1, axis2):
+        # move target axes to the back, set the diagonal, move back
+        perm = [d for d in range(x.ndim) if d not in (axis1 % x.ndim, axis2 % x.ndim)]
+        perm += [axis1 % x.ndim, axis2 % x.ndim]
+        inv = np.argsort(perm)
+        xt = x.transpose(perm)
+        n, m = xt.shape[-2], xt.shape[-1]
+        if offset >= 0:
+            rows = jnp.arange(min(n, m - offset))
+            cols = rows + offset
+        else:
+            cols = jnp.arange(min(m, n + offset))
+            rows = cols - offset
+        xt = xt.at[..., rows, cols].set(jnp.moveaxis(y, -1, -1))
+        return xt.transpose(list(inv))
+
+    _reg("diagonal_scatter_op", impl)
+    return dispatch.apply("diagonal_scatter_op", [x, y],
+                          {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)})
+
+
+def multiplex(inputs, index, name=None):
+    ts = [as_tensor(t) for t in inputs]
+    index = as_tensor(index)
+    opname = f"multiplex_{len(ts)}"
+
+    def impl(i, *xs):
+        stacked = jnp.stack(xs)  # [n, B, ...]
+        sel = i.reshape(-1)[:stacked.shape[1]].astype(jnp.int32)
+        return jnp.take_along_axis(
+            stacked, sel[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0)[0]
+
+    _reg(opname, impl)
+    return dispatch.apply(opname, [index] + ts)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    input = as_tensor(input)
+    _reg("shard_index_op", lambda x, *, index_num, nshards, shard_id, ignore_value:
+         _shard_index_impl(x, index_num, nshards, shard_id, ignore_value))
+    return dispatch.apply("shard_index_op", [input],
+                          {"index_num": int(index_num), "nshards": int(nshards),
+                           "shard_id": int(shard_id), "ignore_value": int(ignore_value)})
+
+
+def _shard_index_impl(x, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+def increment(x, value=1.0, name=None):
+    from .math import add
+
+    return inplace_rebind(x, add(x, value))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (math.py:reduce_as)."""
+    x, target = as_tensor(x), as_tensor(target)
+    tgt_shape = tuple(target.shape)
+    _reg("reduce_as_op", lambda x, *, tgt: _reduce_as_impl(x, tgt))
+    return dispatch.apply("reduce_as_op", [x], {"tgt": tgt_shape})
+
+
+def _reduce_as_impl(x, tgt):
+    lead = x.ndim - len(tgt)
+    axes = tuple(range(lead)) + tuple(
+        lead + i for i, (xs, ts) in enumerate(zip(x.shape[lead:], tgt)) if ts == 1 and xs != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tgt)
+
+
+# ---------------------------------------------------------------------------
+# cumulative / searching (math.py: cummax:3659, cummin; search.py: kthvalue, mode)
+# ---------------------------------------------------------------------------
+
+def _cum_extreme(x, axis, dtype, is_max):
+    """(values, indices) running extreme via an associative scan over (val, idx)."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    n = x.shape[axis]
+    idx = jnp.arange(n, dtype=np.dtype(dtype))
+    idx = idx.reshape([-1 if d == axis else 1 for d in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        better = (bv >= av) if is_max else (bv <= av)
+        return jnp.where(better, bv, av), jnp.where(better, bi, ai)
+
+    vals, idxs = jax.lax.associative_scan(combine, (x, idx), axis=axis)
+    return vals, idxs
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    _reg("cummax_op", lambda x, *, axis, dtype: _cum_extreme(x, axis, dtype, True),
+         multi_out=True)
+    return tuple(dispatch.apply("cummax_op", [x],
+                                {"axis": axis if axis is None else normalize_axis(axis, x.ndim),
+                                 "dtype": str(np.dtype(dtype_mod.to_np(dtype)).name)}))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    _reg("cummin_op", lambda x, *, axis, dtype: _cum_extreme(x, axis, dtype, False),
+         multi_out=True)
+    return tuple(dispatch.apply("cummin_op", [x],
+                                {"axis": axis if axis is None else normalize_axis(axis, x.ndim),
+                                 "dtype": str(np.dtype(dtype_mod.to_np(dtype)).name)}))
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    if axis is None:
+        axis = x.ndim - 1
+    axis = normalize_axis(axis, x.ndim)
+
+    def impl(x, *, k, axis, keepdim):
+        sidx = jnp.argsort(x, axis=axis)
+        sval = jnp.take_along_axis(x, sidx, axis=axis)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(k - 1, k)
+        v, i = sval[tuple(sl)], sidx[tuple(sl)]
+        if not keepdim:
+            v, i = jnp.squeeze(v, axis), jnp.squeeze(i, axis)
+        return v, i.astype(jnp.int64)
+
+    _reg("kthvalue_op", impl, multi_out=True)
+    return tuple(dispatch.apply("kthvalue_op", [x],
+                                {"k": int(k), "axis": axis, "keepdim": bool(keepdim)}))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    axis = normalize_axis(axis, x.ndim)
+
+    def impl(x, *, axis, keepdim):
+        # O(n log n): stable sort, then run-length extents via cummax/cummin of
+        # run-boundary markers (the reference's mode kernel sorts too).
+        xm = jnp.moveaxis(x, axis, -1)
+        n = xm.shape[-1]
+        si = jnp.argsort(xm, axis=-1, stable=True)
+        sv = jnp.take_along_axis(xm, si, axis=-1)
+        bidx = jnp.broadcast_to(jnp.arange(n), sv.shape)
+        run_start = jnp.concatenate(
+            [jnp.ones_like(sv[..., :1], bool), sv[..., 1:] != sv[..., :-1]], axis=-1)
+        run_end = jnp.concatenate(
+            [run_start[..., 1:], jnp.ones_like(run_start[..., :1])], axis=-1)
+        start = jax.lax.cummax(jnp.where(run_start, bidx, 0), axis=xm.ndim - 1)
+        end = jax.lax.cummin(jnp.where(run_end, bidx, n - 1), axis=xm.ndim - 1,
+                             reverse=True)
+        count = end - start + 1
+        # first position holding the max count = smallest-valued mode run
+        pos = jnp.argmax(count, axis=-1)[..., None]
+        val = jnp.take_along_axis(sv, pos, axis=-1)[..., 0]
+        # original index of the run's LAST element (stable sort keeps original
+        # order within a run, so this is the last occurrence)
+        last_sorted = jnp.take_along_axis(end, pos, axis=-1)
+        idx = jnp.take_along_axis(si, last_sorted, axis=-1)[..., 0].astype(jnp.int64)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return val, idx
+
+    _reg("mode_op", impl, multi_out=True)
+    return tuple(dispatch.apply("mode_op", [x], {"axis": axis, "keepdim": bool(keepdim)}))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = as_tensor(x), as_tensor(test_x)
+    _reg("isin_op", lambda x, t, *, invert: jnp.isin(x, t, invert=invert))
+    return dispatch.apply("isin_op", [x, test_x], {"invert": bool(invert)})
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    input = as_tensor(input)
+
+    def impl(x, *, bins, min, max):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(x), jnp.max(x))
+        hi = jnp.where(hi == lo, lo + 1.0, hi)
+        return jnp.linspace(lo, hi, bins + 1)
+
+    _reg("histogram_bin_edges_op", impl)
+    return dispatch.apply("histogram_bin_edges_op", [input],
+                          {"bins": int(bins), "min": float(min), "max": float(max)})
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    x = as_tensor(x)
+    sample = np.asarray(x.numpy())
+    w = np.asarray(as_tensor(weights).numpy()) if weights is not None else None
+    if isinstance(bins, (list, tuple)) and len(bins) and isinstance(bins[0], Tensor):
+        bins = [np.asarray(b.numpy()) for b in bins]
+    hist, edges = np.histogramdd(sample, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return (Tensor(jnp.asarray(hist), stop_gradient=True),
+            [Tensor(jnp.asarray(e), stop_gradient=True) for e in edges])
+
+
+# ---------------------------------------------------------------------------
+# special functions (math.py + phi/kernels: lgamma, gammainc, polygamma, ...)
+# ---------------------------------------------------------------------------
+
+logit_base = None  # placeholder to keep module flat
+
+
+def logit(x, eps=None, name=None):
+    x = as_tensor(x)
+
+    def impl(x, *, eps):
+        if eps is not None:
+            x = jnp.clip(x, eps, 1.0 - eps)
+        return jnp.log(x) - jnp.log1p(-x)
+
+    _reg("logit_op", impl)
+    return dispatch.apply("logit_op", [x], {"eps": float(eps) if eps is not None else None})
+
+
+sinc = make_float_unary("sinc", jnp.sinc)
+gammaln = make_float_unary("gammaln", jax.scipy.special.gammaln)
+i0e = make_float_unary("i0e", jax.scipy.special.i0e)
+i1e = make_float_unary("i1e", jax.scipy.special.i1e)
+gammainc = make_binary("gammainc", jax.scipy.special.gammainc, float_only=True)
+gammaincc = make_binary("gammaincc", jax.scipy.special.gammaincc, float_only=True)
+ldexp = make_binary("ldexp", lambda x, e: x * jnp.exp2(e.astype(x.dtype)), float_only=True)
+
+
+def multigammaln(x, p, name=None):
+    x = as_tensor(x)
+    _reg("multigammaln_op", lambda x, *, p: jax.scipy.special.multigammaln(x, p))
+    return dispatch.apply("multigammaln_op", [x], {"p": int(p)})
+
+
+def polygamma(x, n, name=None):
+    x = as_tensor(x)
+    _reg("polygamma_op", lambda x, *, n: jax.scipy.special.polygamma(n, x))
+    return dispatch.apply("polygamma_op", [x], {"n": int(n)})
+
+
+def frexp(x, name=None):
+    x = as_tensor(x)
+    _reg("frexp_op", lambda x: tuple(jnp.frexp(x)), multi_out=True)
+    m, e = dispatch.apply("frexp_op", [x])
+    return m, e
+
+
+def signbit(x, name=None):
+    _reg("signbit_op", jnp.signbit)
+    return dispatch.apply("signbit_op", [as_tensor(x)])
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (math.py:sgn)."""
+    x = as_tensor(x)
+
+    def impl(x):
+        if jnp.iscomplexobj(x):
+            mag = jnp.abs(x)
+            return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+        return jnp.sign(x)
+
+    _reg("sgn_op", impl)
+    return dispatch.apply("sgn_op", [x])
+
+
+def isneginf(x, name=None):
+    _reg("isneginf_op", jnp.isneginf)
+    return dispatch.apply("isneginf_op", [as_tensor(x)])
+
+
+def isposinf(x, name=None):
+    _reg("isposinf_op", jnp.isposinf)
+    return dispatch.apply("isposinf_op", [as_tensor(x)])
+
+
+def isreal(x, name=None):
+    _reg("isreal_op", jnp.isreal)
+    return dispatch.apply("isreal_op", [as_tensor(x)])
+
+
+def is_complex(x):
+    return np.issubdtype(np.dtype(as_tensor(x)._data.dtype), np.complexfloating)
+
+
+def is_floating_point(x):
+    return np.issubdtype(np.dtype(as_tensor(x)._data.dtype), np.floating) or \
+        str(as_tensor(x)._data.dtype) == "bfloat16"
+
+
+def is_integer(x):
+    return np.issubdtype(np.dtype(as_tensor(x)._data.dtype), np.integer)
+
+
+def complex(real, imag, name=None):
+    real, imag = prep_binary(real, imag)
+    _reg("complex_op", lambda r, i: jax.lax.complex(r, i))
+    return dispatch.apply("complex_op", [real, imag])
+
+
+def polar(abs, angle, name=None):
+    abs, angle = prep_binary(abs, angle)
+    _reg("polar_op", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)))
+    return dispatch.apply("polar_op", [abs, angle])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = as_tensor(x)
+    axis = normalize_axis(axis, x.ndim)
+
+    def impl(x, *, p, axis, max_norm):
+        red = tuple(d for d in range(x.ndim) if d != axis)
+        norms = jnp.sum(jnp.abs(x) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                           jnp.ones_like(norms))
+        return x * factor
+
+    _reg("renorm_op", impl)
+    return dispatch.apply("renorm_op", [x],
+                          {"p": float(p), "axis": axis, "max_norm": float(max_norm)})
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        x = as_tensor(x)
+        _reg("trapezoid_x", lambda y, x, *, axis: jnp.trapezoid(y, x=x, axis=axis))
+        return dispatch.apply("trapezoid_x", [y, x], {"axis": int(axis)})
+    _reg("trapezoid_dx", lambda y, *, dx, axis: jnp.trapezoid(y, dx=dx, axis=axis))
+    return dispatch.apply("trapezoid_dx", [y], {"dx": float(dx if dx is not None else 1.0),
+                                                "axis": int(axis)})
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+
+    def impl_dx(y, *, dx, axis):
+        ym = jnp.moveaxis(y, axis, -1)
+        avg = (ym[..., 1:] + ym[..., :-1]) * 0.5 * dx
+        return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+    if x is not None:
+        x = as_tensor(x)
+
+        def impl_x(y, x, *, axis):
+            ym = jnp.moveaxis(y, axis, -1)
+            xm = jnp.moveaxis(jnp.broadcast_to(x, y.shape) if x.ndim == y.ndim else x, axis if x.ndim == y.ndim else 0, -1)
+            d = jnp.diff(xm, axis=-1)
+            avg = (ym[..., 1:] + ym[..., :-1]) * 0.5 * d
+            return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+        _reg("cumulative_trapezoid_x", impl_x)
+        return dispatch.apply("cumulative_trapezoid_x", [y, x], {"axis": int(axis)})
+    _reg("cumulative_trapezoid_dx", impl_dx)
+    return dispatch.apply("cumulative_trapezoid_dx", [y],
+                          {"dx": float(dx if dx is not None else 1.0), "axis": int(axis)})
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Pairwise p-distances [..., P, R] (linalg.py:cdist). p=2 uses the
+    matmul expansion so the MXU does the heavy lifting."""
+    x, y = prep_binary(x, y)
+
+    def impl(x, y, *, p):
+        if p == 2.0:
+            x2 = jnp.sum(x * x, axis=-1, keepdims=True)          # [..., P, 1]
+            y2 = jnp.sum(y * y, axis=-1, keepdims=True)          # [..., R, 1]
+            xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))          # [..., P, R]
+            d2 = jnp.maximum(x2 - 2.0 * xy + jnp.swapaxes(y2, -1, -2), 0.0)
+            return jnp.sqrt(d2)
+        diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+        if np.isinf(p):
+            return jnp.max(diff, axis=-1)
+        return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+    _reg("cdist_op", impl)
+    return dispatch.apply("cdist_op", [x, y], {"p": float(p)})
+
+
+def pdist(x, p=2.0, name=None):
+    x = as_tensor(x)
+    n = x.shape[0]
+    full = cdist(x, x, p=p)
+    iu = np.triu_indices(n, 1)
+    _reg("pdist_gather", lambda d, r, c: d[r, c])
+    return dispatch.apply("pdist_gather",
+                          [full, Tensor(jnp.asarray(iu[0]), stop_gradient=True),
+                           Tensor(jnp.asarray(iu[1]), stop_gradient=True)])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = as_tensor(x)
+    qs = tuple(float(v) for v in (q if isinstance(q, (list, tuple)) else [q]))
+    ax = normalize_axis(axis, x.ndim)
+
+    def impl(x, *, qs, axis, keepdim, method):
+        out = jnp.nanquantile(x, jnp.asarray(qs), axis=axis, keepdims=keepdim,
+                              method=method)
+        return out if len(qs) > 1 else out[0]
+
+    _reg("nanquantile_op", impl)
+    return dispatch.apply("nanquantile_op", [x],
+                          {"qs": qs, "axis": ax, "keepdim": bool(keepdim),
+                           "method": str(interpolation)})
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = prep_binary(x, y)
+    if isinstance(axes, int):
+        key = int(axes)
+    else:
+        a, b = axes
+        a = [a] if isinstance(a, int) else list(a)
+        b = [b] if isinstance(b, int) else list(b)
+        key = (tuple(a), tuple(b))
+    opname = f"tensordot_{key}"
+    _reg(opname, lambda x, y, *, axes: jnp.tensordot(
+        x, y, axes=axes if isinstance(axes, int) else tuple(list(a) for a in axes)))
+    return dispatch.apply(opname, [x, y],
+                          {"axes": key if isinstance(key, int) else key})
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    npd = dtype_mod.to_np(dtype) if dtype is not None else dtype_mod.get_default_dtype().np_dtype
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base),
+                               dtype=np.dtype(npd)), stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# random samplers (phi/kernels/gpu/{poisson,binomial,...}_kernel.cu analogs)
+# ---------------------------------------------------------------------------
+
+def standard_normal(shape, dtype=None, name=None):
+    from .creation import randn
+
+    return randn(shape, dtype=dtype)
+
+
+def standard_gamma(x, name=None):
+    x = as_tensor(x)
+    _reg("standard_gamma_op", lambda key, a: jax.random.gamma(key, a))
+    return dispatch.apply("standard_gamma_op", [_key_tensor(), x])
+
+
+def poisson(x, name=None):
+    x = as_tensor(x)
+    _reg("poisson_op", lambda key, lam: jax.random.poisson(key, lam).astype(lam.dtype))
+    return dispatch.apply("poisson_op", [_key_tensor(), x])
+
+
+def binomial(count, prob, name=None):
+    count, prob = prep_binary(count, prob)
+    _reg("binomial_op", lambda key, n, p: jax.random.binomial(
+        key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64))
+    return dispatch.apply("binomial_op", [_key_tensor(), count, prob])
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from .creation import normal as _normal
+    from .math import exp
+
+    return exp(_normal(mean=mean, std=std, shape=shape))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    from .creation import normal as _normal
+
+    return inplace_rebind(x, as_tensor(
+        _normal(mean=mean, std=std, shape=tuple(x.shape)), dtype=str(x._data.dtype)))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    return inplace_rebind(x, as_tensor(
+        log_normal(mean=mean, std=std, shape=tuple(x.shape)), dtype=str(x._data.dtype)))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x = as_tensor(x)
+    _reg("cauchy_op", lambda key, *, shape, loc, scale, dtype: loc + scale * jax.random.cauchy(
+        key, shape, dtype=np.dtype(dtype)))
+    out = dispatch.apply("cauchy_op", [_key_tensor()],
+                         {"shape": tuple(x.shape), "loc": float(loc),
+                          "scale": float(scale),
+                          "dtype": "float32" if str(x._data.dtype) not in
+                          ("float32", "float64", "bfloat16") else str(x._data.dtype)})
+    from .manipulation import cast
+
+    return inplace_rebind(x, cast(out, str(x._data.dtype)))
+
+
+def geometric_(x, probs, name=None):
+    x = as_tensor(x)
+    _reg("geometric_op", lambda key, *, shape, p, dtype: jax.random.geometric(
+        key, p, shape).astype(np.dtype(dtype)))
+    out = dispatch.apply("geometric_op", [_key_tensor()],
+                         {"shape": tuple(x.shape), "p": float(probs),
+                          "dtype": str(x._data.dtype) if str(x._data.dtype) != "bfloat16"
+                          else "float32"})
+    from .manipulation import cast
+
+    return inplace_rebind(x, cast(out, str(x._data.dtype)))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    from .creation import rand
+
+    mask = rand(tuple(x.shape))
+    from .comparison import less_than
+    from .manipulation import cast
+
+    return inplace_rebind(x, cast(less_than(mask, p), str(x._data.dtype)))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = as_tensor(x)
+    _reg("exponential_op", lambda key, *, shape, lam, dtype: jax.random.exponential(
+        key, shape, dtype=np.dtype(dtype)) / lam)
+    out = dispatch.apply("exponential_op", [_key_tensor()],
+                         {"shape": tuple(x.shape), "lam": float(lam),
+                          "dtype": "float32" if str(x._data.dtype) == "bfloat16"
+                          else str(x._data.dtype)})
+    from .manipulation import cast
+
+    return inplace_rebind(x, cast(out, str(x._data.dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    from .creation import randint
+
+    x = as_tensor(x)
+    return randint(low, high, shape=tuple(x.shape),
+                   dtype=dtype or str(x._data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# framework-surface helpers: finfo/iinfo/tolist/printoptions (base/framework.py)
+# ---------------------------------------------------------------------------
+
+class finfo:
+    def __init__(self, dtype):
+        npd = dtype_mod.to_np(dtype)
+        try:
+            info = np.finfo(npd)
+        except ValueError:  # ml_dtypes types (bfloat16, fp8) need their own finfo
+            import ml_dtypes
+
+            info = ml_dtypes.finfo(npd)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(getattr(info, "tiny", getattr(info, "smallest_normal", 0.0)))
+        self.smallest_normal = self.tiny
+        self.resolution = float(getattr(info, "resolution", self.eps))
+        self.bits = int(info.bits)
+        self.dtype = str(dtype_mod.convert_dtype(dtype))
+
+
+class iinfo:
+    def __init__(self, dtype):
+        info = np.iinfo(dtype_mod.to_np(dtype))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(dtype_mod.convert_dtype(dtype))
+
+
+def tolist(x):
+    return np.asarray(as_tensor(x).numpy()).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ signal handlers (paddle/fluid/platform/
+    init.cc); the TPU build has no native handlers to disable."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reader-decorator batching (python/paddle/reader — legacy API)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (math.py:add_n → sum_op)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [as_tensor(t) for t in inputs]
+    opname = f"add_n_{len(ts)}"
+    _reg(opname, lambda *xs: sum(xs[1:], xs[0]))
+    return dispatch.apply(opname, ts)
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from .math import addmm
+
+    return inplace_rebind(input, addmm(input, x, y, beta=beta, alpha=alpha))
+
+
+def check_shape(shape):
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and s is not None:
+            raise TypeError(f"shape entries must be ints, got {type(s)}")
+
+
+# ---------------------------------------------------------------------------
+# in-place variant generation (eager_gen.py emits *_ ad_funcs in the reference;
+# here each is compute-out-of-place + inplace_rebind)
+# ---------------------------------------------------------------------------
+
+def _make_inplace(fn):
+    def api(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        return inplace_rebind(x, out)
+
+    api.__name__ = fn.__name__ + "_"
+    return api
+
+
+def _build_inplace_table():
+    from . import comparison, manipulation, math as math_ops
+
+    table = {}
+    unary_sources = {
+        "abs": math_ops.abs, "acos": math_ops.acos, "asin": math_ops.asin,
+        "atan": math_ops.atan, "sin": math_ops.sin, "cos": math_ops.cos,
+        "tan": math_ops.tan, "sinh": math_ops.sinh, "cosh": math_ops.cosh,
+        "tanh": math_ops.tanh, "asinh": math_ops.asinh, "acosh": math_ops.acosh,
+        "atanh": math_ops.atanh, "erf": math_ops.erf, "exp": math_ops.exp,
+        "expm1": math_ops.expm1, "floor": math_ops.floor, "ceil": math_ops.ceil,
+        "round": math_ops.round, "trunc": math_ops.trunc, "sqrt": math_ops.sqrt,
+        "rsqrt": math_ops.rsqrt, "square": math_ops.square,
+        "reciprocal": math_ops.reciprocal, "neg": math_ops.neg,
+        "log": math_ops.log, "log2": math_ops.log2, "log10": math_ops.log10,
+        "log1p": math_ops.log1p, "sigmoid": math_ops.sigmoid,
+        "digamma": math_ops.digamma, "lgamma": math_ops.lgamma,
+        "frac": math_ops.frac, "i0": math_ops.i0,
+        "nan_to_num": math_ops.nan_to_num, "logit": logit, "sinc": sinc,
+        "gammaln": gammaln, "polygamma": polygamma, "multigammaln": multigammaln,
+        "renorm": renorm, "erfinv": math_ops.erfinv,
+    }
+    binary_sources = {
+        "pow": math_ops.pow, "divide": math_ops.divide,
+        "floor_divide": math_ops.floor_divide, "mod": math_ops.remainder,
+        "remainder": math_ops.remainder, "gcd": math_ops.gcd,
+        "lcm": math_ops.lcm, "hypot": math_ops.hypot, "ldexp": ldexp,
+        "copysign": math_ops.copysign, "gammainc": gammainc,
+        "gammaincc": gammaincc, "heaviside": math_ops.heaviside,
+        "bitwise_and": comparison.bitwise_and, "bitwise_or": comparison.bitwise_or,
+        "bitwise_xor": comparison.bitwise_xor,
+        "bitwise_left_shift": comparison.bitwise_left_shift,
+        "bitwise_right_shift": comparison.bitwise_right_shift,
+        "logical_and": comparison.logical_and,
+        "logical_or": comparison.logical_or,
+        "logical_xor": comparison.logical_xor,
+        "equal": comparison.equal, "not_equal": comparison.not_equal,
+        "greater_equal": comparison.greater_equal,
+        "greater_than": comparison.greater_than,
+        "less_equal": comparison.less_equal, "less_than": comparison.less_than,
+        "masked_fill": manipulation.masked_fill, "masked_scatter": masked_scatter,
+    }
+    other_sources = {
+        "bitwise_not": comparison.bitwise_not,
+        "logical_not": comparison.logical_not,
+        "cumsum": math_ops.cumsum, "cumprod": math_ops.cumprod,
+        "flatten": manipulation.flatten, "cast": manipulation.cast,
+        "tril": None, "triu": None,  # filled below (creation)
+        "t": manipulation.t, "transpose": manipulation.transpose,
+        "scatter": manipulation.scatter,
+        "index_add": index_add, "index_fill": index_fill, "index_put": index_put,
+        "fill_diagonal": None,
+    }
+    from .creation import tril as _tril, triu as _triu
+
+    other_sources["tril"] = _tril
+    other_sources["triu"] = _triu
+    other_sources.pop("fill_diagonal")
+    for name, fn in {**unary_sources, **binary_sources, **other_sources}.items():
+        table[name + "_"] = _make_inplace(fn)
+    table["floor_mod_"] = table["mod_"]
+    return table
+
+
+_INPLACE = _build_inplace_table()
+globals().update(_INPLACE)
+
+
+__all__ = [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack", "hsplit",
+    "vsplit", "dsplit", "tensor_split", "atleast_1d", "atleast_2d",
+    "atleast_3d", "block_diag", "unflatten", "unfold", "view", "view_as",
+    "as_strided", "reverse", "take", "trace", "vander", "tril_indices",
+    "triu_indices", "cartesian_prod", "combinations", "index_add",
+    "index_fill", "index_put", "masked_scatter",
+    "slice_scatter", "diagonal_scatter", "multiplex", "shard_index",
+    "increment", "reduce_as", "cummax", "cummin", "kthvalue", "mode", "isin",
+    "histogram_bin_edges", "histogramdd", "logit", "sinc", "gammaln", "i0e",
+    "i1e", "gammainc", "gammaincc", "ldexp", "multigammaln", "polygamma",
+    "frexp", "signbit", "sgn", "isneginf", "isposinf", "isreal", "is_complex",
+    "is_floating_point", "is_integer", "complex", "polar",
+    "renorm", "trapezoid", "cumulative_trapezoid", "cdist", "pdist",
+    "nanquantile", "tensordot",
+    "logspace", "standard_normal", "standard_gamma", "poisson", "binomial",
+    "log_normal", "normal_", "log_normal_", "cauchy_", "geometric_",
+    "bernoulli_", "exponential_", "randint_like", "finfo", "iinfo", "tolist",
+    "set_printoptions", "disable_signal_handler", "batch", "check_shape",
+    "add_n", "addmm_",
+] + sorted(_INPLACE)

@@ -1,0 +1,107 @@
+"""`paddle.flops` — per-layer FLOPs/param accounting via forward hooks.
+
+Reference: python/paddle/hapi/dynamic_flops.py (flops():34, register hooks per
+layer type, run one forward, sum). Same mechanism here: hook the leaf layers,
+trace one forward on zeros, count multiply-adds analytically per layer type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _conv_flops(layer, x, y):
+    # out_elems * (kh*kw*cin/groups) MACs (+ bias)
+    out = np.prod(y.shape)
+    k = np.prod(layer._kernel_size) if hasattr(layer, "_kernel_size") else \
+        np.prod(layer.weight.shape[2:])
+    cin = layer.weight.shape[1]
+    total = out * k * cin
+    if getattr(layer, "bias", None) is not None:
+        total += out
+    return int(total)
+
+
+def _linear_flops(layer, x, y):
+    total = np.prod(x.shape) * layer.weight.shape[-1]
+    if getattr(layer, "bias", None) is not None:
+        total += np.prod(y.shape)
+    return int(total)
+
+
+def _norm_flops(layer, x, y):
+    return int(2 * np.prod(x.shape))
+
+
+def _act_flops(layer, x, y):
+    return int(np.prod(x.shape))
+
+
+def _pool_flops(layer, x, y):
+    return int(np.prod(y.shape))
+
+
+def _layer_flops(layer, x, y, custom_ops):
+    from .. import nn
+
+    cls = type(layer)
+    if custom_ops and cls in custom_ops:
+        return int(custom_ops[cls](layer, x, y))
+    name = cls.__name__
+    if "Conv" in name:
+        return _conv_flops(layer, x, y)
+    if name == "Linear":
+        return _linear_flops(layer, x, y)
+    if "Norm" in name:
+        return _norm_flops(layer, x, y)
+    if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+                "LeakyReLU", "SiLU", "Hardswish", "Hardsigmoid"):
+        return _act_flops(layer, x, y)
+    if "Pool" in name:
+        return _pool_flops(layer, x, y)
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count FLOPs of one forward pass on zeros of `input_size`.
+
+    Returns the total; with print_detail=True prints a per-layer table
+    (reference hapi/dynamic_flops.py:flops prints via PrettyTable)."""
+    from .. import zeros
+    from ..core.autograd import no_grad
+
+    records = []
+    handles = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            y = output[0] if isinstance(output, (list, tuple)) else output
+            n_params = sum(int(np.prod(p.shape)) for p in lyr.parameters(
+                include_sublayers=False))
+            records.append((type(lyr).__name__,
+                            _layer_flops(lyr, x, y, custom_ops), n_params))
+
+        return hook
+
+    for layer in net.sublayers(include_self=False):
+        if not layer.sublayers():  # leaves only
+            handles.append(layer.register_forward_post_hook(make_hook(layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with no_grad():
+            net(zeros(list(input_size)))
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(r[1] for r in records)
+    if print_detail:
+        print(f"{'Layer':<24}{'FLOPs':>16}{'Params':>12}")
+        for name, fl, pc in records:
+            print(f"{name:<24}{fl:>16}{pc:>12}")
+        print(f"Total FLOPs: {total}")
+    return int(total)

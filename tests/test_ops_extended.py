@@ -1,0 +1,276 @@
+"""Extended op coverage tests (ops/extended.py) — stacking/splitting, scatter
+families, special functions, searching, distances, in-place variants.
+
+Mirrors the reference's per-op unit tests under test/legacy_test/ (SURVEY.md §4:
+one test file per op, forward vs numpy)."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+
+
+def t(x, dtype=None):
+    return paddle.to_tensor(np.asarray(x, dtype=dtype))
+
+
+def check(out, ref, tol=1e-5):
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float64),
+                               np.asarray(ref, np.float64), rtol=tol, atol=tol)
+
+
+class TestStackSplit:
+    def test_stack_family(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        check(paddle.hstack([t(x), t(x)]), np.hstack([x, x]))
+        check(paddle.vstack([t(x), t(x)]), np.vstack([x, x]))
+        check(paddle.dstack([t(x), t(x)]), np.dstack([x, x]))
+        check(paddle.column_stack([t(x[:, 0]), t(x[:, 1])]),
+              np.column_stack([x[:, 0], x[:, 1]]))
+
+    def test_split_family(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        for mine, ref in zip(paddle.hsplit(t(x), 3), np.hsplit(x, 3)):
+            check(mine, ref)
+        for mine, ref in zip(paddle.vsplit(t(x), 2), np.vsplit(x, 2)):
+            check(mine, ref)
+        parts = paddle.tensor_split(t(np.arange(10.0)), 3)
+        assert [p.shape[0] for p in parts] == [4, 3, 3]
+
+    def test_atleast_block_diag(self):
+        assert paddle.atleast_2d(t(3.0)).shape == [1, 1]
+        assert paddle.atleast_3d(t([1.0, 2.0])).shape == [1, 2, 1]
+        bd = paddle.block_diag([t(np.ones((2, 2), np.float32)),
+                                t(np.ones((1, 1), np.float32))])
+        assert bd.shape == [3, 3] and float(bd.numpy()[2, 2]) == 1.0
+
+    def test_unflatten_unfold_view(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        assert paddle.unflatten(t(x), 1, (2, 2)).shape == [3, 2, 2]
+        u = paddle.unfold(t(np.arange(8.0)), 0, 4, 2)
+        check(u, np.stack([np.arange(8.0)[i:i + 4] for i in (0, 2, 4)]))
+        assert paddle.view(t(x), [4, 3]).shape == [4, 3]
+        assert paddle.view_as(t(x), t(np.zeros((2, 6)))).shape == [2, 6]
+        s = paddle.as_strided(t(np.arange(9.0)), [3, 3], [1, 3])
+        check(s, np.arange(9.0).reshape(3, 3).T.T.reshape(3, 3)[
+            np.arange(3)[:, None] * 0 + np.arange(3)[:, None] * 1 // 1,
+            np.arange(3)[None, :]] if False else
+            np.array([[0, 3, 6], [1, 4, 7], [2, 5, 8]], np.float64))
+
+
+class TestScatterFamilies:
+    def test_index_add_fill_put(self):
+        x = np.zeros((3, 4), np.float32)
+        out = paddle.index_add(t(x), t([0, 2]), 0, t(np.ones((2, 4), np.float32)))
+        ref = x.copy(); ref[[0, 2]] += 1
+        check(out, ref)
+        out = paddle.index_fill(t(x), t([1]), 0, 9.0)
+        assert np.allclose(out.numpy()[1], 9)
+        out = paddle.index_put(t(x), [t([0]), t([1])], t(np.array([5.0], np.float32)))
+        assert float(out.numpy()[0, 1]) == 5.0
+
+    def test_masked_scatter(self):
+        out = paddle.masked_scatter(t(np.zeros(5, np.float32)),
+                                    t(np.array([1, 0, 1, 0, 1], bool)),
+                                    t(np.array([7.0, 8.0, 9.0], np.float32)))
+        check(out, [7, 0, 8, 0, 9])
+
+    def test_scatter_views(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = paddle.select_scatter(t(x), t(np.zeros(4, np.float32)), 0, 1)
+        assert np.allclose(out.numpy()[1], 0)
+        out = paddle.slice_scatter(t(x), t(np.zeros((3, 2), np.float32)),
+                                   [1], [0], [2], [1])
+        assert np.allclose(out.numpy()[:, :2], 0)
+        out = paddle.diagonal_scatter(t(np.zeros((3, 3), np.float32)),
+                                      t(np.array([1.0, 2.0, 3.0], np.float32)))
+        assert np.allclose(np.diag(out.numpy()), [1, 2, 3])
+
+    def test_multiplex_shard_index(self):
+        out = paddle.multiplex([t(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)),
+                                t(np.array([[5.0, 6.0], [7.0, 8.0]], np.float32))],
+                               t(np.array([[0], [1]])))
+        check(out, [[1, 2], [7, 8]])
+        out = paddle.shard_index(t(np.array([1, 7])), 10, 2, 0)
+        assert out.numpy().tolist() == [1, -1]
+
+
+class TestSearchCumulative:
+    def test_cummax_cummin(self):
+        x = np.array([3.0, 1.0, 4.0, 1.0, 5.0], np.float32)
+        v, i = paddle.cummax(t(x))
+        check(v, np.maximum.accumulate(x))
+        assert i.numpy().tolist() == [0, 0, 2, 2, 4]
+        v, i = paddle.cummin(t(x))
+        check(v, np.minimum.accumulate(x))
+
+    def test_kthvalue_mode_isin(self):
+        v, i = paddle.kthvalue(t(np.array([5.0, 1.0, 3.0], np.float32)), 2)
+        assert float(v.numpy()) == 3.0 and int(i.numpy()) == 2
+        v, i = paddle.mode(t(np.array([1.0, 2.0, 2.0, 3.0], np.float32)))
+        assert float(v.numpy()) == 2.0
+        out = paddle.isin(t(np.array([1, 2, 3])), t(np.array([2])))
+        assert out.numpy().tolist() == [False, True, False]
+
+    def test_take_trace(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        check(paddle.take(t(x), t([0, 5, 11])), [0, 5, 11])
+        check(paddle.trace(t(x)), np.trace(x))
+        check(paddle.trace(t(x), offset=1), np.trace(x, offset=1))
+
+
+class TestSpecialFunctions:
+    def test_gamma_family(self):
+        check(paddle.gammaln(t(4.0, np.float32)), sp.gammaln(4), tol=1e-4)
+        check(paddle.gammainc(t(2.0, np.float32), t(1.0, np.float32)),
+              sp.gammainc(2, 1))
+        check(paddle.gammaincc(t(2.0, np.float32), t(1.0, np.float32)),
+              sp.gammaincc(2, 1))
+        check(paddle.multigammaln(t(4.0, np.float32), 2),
+              sp.multigammaln(4, 2), tol=1e-4)
+        check(paddle.polygamma(t(2.0, np.float32), 1),
+              sp.polygamma(1, 2), tol=1e-4)
+
+    def test_logit_sinc_signbit_sgn(self):
+        check(paddle.logit(t(0.75, np.float32)), np.log(3.0))
+        check(paddle.sinc(t(0.5, np.float32)), np.sinc(0.5))
+        assert bool(paddle.signbit(t(-1.0, np.float32)).numpy())
+        check(paddle.sgn(t(-3.0, np.float32)), -1.0)
+
+    def test_frexp_ldexp(self):
+        m, e = paddle.frexp(t([8.0], np.float32))
+        assert float(m.numpy()) == 0.5 and int(e.numpy()) == 4
+        check(paddle.ldexp(t([1.5], np.float32), t([3])), [12.0])
+
+    def test_complex_polar(self):
+        c = paddle.complex(t(1.0, np.float32), t(2.0, np.float32))
+        assert c.numpy() == 1 + 2j
+        pl = paddle.polar(t(1.0, np.float32), t(np.pi / 2, np.float32))
+        assert abs(np.imag(pl.numpy()) - 1.0) < 1e-6
+
+
+class TestDistancesIntegrals:
+    def test_cdist_pdist(self):
+        a = np.zeros((2, 3), np.float32)
+        b = np.ones((4, 3), np.float32)
+        check(paddle.cdist(t(a), t(b)), np.full((2, 4), np.sqrt(3)))
+        check(paddle.cdist(t(a), t(b), p=1.0), np.full((2, 4), 3.0))
+        out = paddle.pdist(t(np.array([[0.0, 0.0], [3.0, 4.0]], np.float32)))
+        check(out, [5.0])
+
+    def test_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        check(paddle.trapezoid(t(y)), 4.0)
+        check(paddle.cumulative_trapezoid(t(y)), [1.5, 4.0])
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        check(paddle.trapezoid(t(y), x=t(x)), np.trapezoid(y, x))
+
+    def test_renorm_tensordot(self):
+        out = paddle.renorm(t(np.ones((2, 3), np.float32) * 2), 2.0, 0, 1.0)
+        assert np.allclose(np.linalg.norm(out.numpy(), axis=1), 1.0, atol=1e-5)
+        out = paddle.tensordot(t(np.ones((2, 3), np.float32)),
+                               t(np.ones((3, 4), np.float32)), axes=1)
+        check(out, np.full((2, 4), 3.0))
+        out = paddle.tensordot(t(np.ones((2, 3), np.float32)),
+                               t(np.ones((4, 3), np.float32)), axes=([1], [1]))
+        assert out.shape == [2, 4]
+
+    def test_nanquantile(self):
+        out = paddle.nanquantile(t(np.array([1.0, np.nan, 3.0], np.float32)), 0.5)
+        assert float(out.numpy()) == 2.0
+
+
+class TestRandomSamplers:
+    def test_shapes_and_support(self):
+        assert paddle.standard_normal([2, 3]).shape == [2, 3]
+        out = paddle.poisson(t(np.full((100,), 5.0, np.float32)))
+        assert 3.0 < float(out.numpy().mean()) < 7.0
+        out = paddle.binomial(t(np.array([10])), t(np.array([0.5])))
+        assert 0 <= int(out.numpy()) <= 10
+        out = paddle.standard_gamma(t(np.full((100,), 2.0, np.float32)))
+        assert (out.numpy() >= 0).all()
+        x = t(np.zeros(100, np.float32))
+        paddle.bernoulli_(x)
+        assert set(np.unique(x.numpy())).issubset({0.0, 1.0})
+        y = t(np.zeros(100, np.float32))
+        y.exponential_(2.0)
+        assert (y.numpy() >= 0).all()
+
+    def test_randint_like(self):
+        out = paddle.randint_like(t(np.zeros((2, 2), np.int64)), 0, 10)
+        assert out.shape == [2, 2] and (out.numpy() < 10).all()
+
+
+class TestInplaceVariants:
+    def test_unary_inplace(self):
+        x = t(np.array([4.0, 9.0], np.float32))
+        ret = x.sqrt_()
+        assert ret is x
+        check(x, [2.0, 3.0])
+        x = t(np.array([1.0, 2.0], np.float32))
+        x.exp_()
+        check(x, np.exp([1.0, 2.0]))
+
+    def test_binary_inplace(self):
+        x = t(np.array([7.0, 8.0], np.float32))
+        x.divide_(t(np.array([2.0, 4.0], np.float32)))
+        check(x, [3.5, 2.0])
+        x = t(np.array([5], np.int64))
+        x.bitwise_left_shift_(t(np.array([2], np.int64)))
+        assert int(x.numpy()) == 20
+
+    def test_top_level_inplace(self):
+        x = t(np.array([1.0, -1.0], np.float32))
+        paddle.abs_(x)
+        check(x, [1.0, 1.0])
+        paddle.increment(x, 2.0)
+        check(x, [3.0, 3.0])
+
+    def test_inplace_leaf_guard(self):
+        x = t(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        with pytest.raises(RuntimeError):
+            x.sqrt_()
+
+
+class TestMiscSurface:
+    def test_finfo_iinfo(self):
+        assert paddle.finfo("bfloat16").bits == 16
+        assert paddle.finfo(paddle.float32).eps == np.finfo(np.float32).eps
+        assert paddle.iinfo("int32").max == 2**31 - 1
+
+    def test_indices_vander_logspace(self):
+        ti = paddle.tril_indices(3)
+        assert ti.shape == [2, 6]
+        check(paddle.vander(t(np.array([1.0, 2.0, 3.0], np.float32)), 3),
+              np.vander([1, 2, 3], 3))
+        check(paddle.logspace(0, 2, 3), [1.0, 10.0, 100.0])
+
+    def test_cartesian_combinations(self):
+        cp = paddle.cartesian_prod([t(np.array([1.0, 2.0], np.float32)),
+                                    t(np.array([3.0, 4.0, 5.0], np.float32))])
+        assert cp.shape == [6, 2]
+        cb = paddle.combinations(t(np.array([1.0, 2.0, 3.0], np.float32)), 2)
+        check(cb, [[1, 2], [1, 3], [2, 3]])
+
+    def test_add_n_reduce_as(self):
+        xs = [t(np.ones((2, 2), np.float32)) for _ in range(3)]
+        check(paddle.add_n(xs), np.full((2, 2), 3.0))
+        out = paddle.reduce_as(t(np.ones((3, 4), np.float32)),
+                               t(np.ones((1, 4), np.float32)))
+        check(out, np.full((1, 4), 3.0))
+
+    def test_histogram_tools(self):
+        e = paddle.histogram_bin_edges(t(np.array([0.0, 1.0], np.float32)), bins=4)
+        check(e, np.linspace(0, 1, 5))
+        h, edges = paddle.histogramdd(t(np.random.randn(50, 2).astype(np.float32)),
+                                      bins=4)
+        assert h.shape == [4, 4] and len(edges) == 2
+        assert float(h.numpy().sum()) == 50.0
+
+    def test_tolist_is_checks(self):
+        assert paddle.tolist(t([1, 2])) == [1, 2]
+        assert paddle.is_floating_point(t(1.0, np.float32))
+        assert paddle.is_integer(t([1]))
+        assert not paddle.is_complex(t(1.0, np.float32))
+        assert bool(paddle.is_empty(t(np.zeros((0, 3), np.float32))).numpy())
