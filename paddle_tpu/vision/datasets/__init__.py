@@ -1,0 +1,209 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/{mnist,cifar,folder}.py).
+
+No-egress environment: `download=True` raises with instructions; each dataset
+reads the standard archive format from a local path (IDX for MNIST, pickled
+batches for CIFAR, directory trees for DatasetFolder)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment; pass "
+        f"image_path/label_path (or data_file) pointing at a local copy, or "
+        f"download=False with files already in place")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (mnist.py:MNIST). mode: train|test."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError("image_path and label_path are required")
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _parse_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad IDX image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _parse_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad IDX label magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-pickle tar.gz (cifar.py:Cifar10)."""
+
+    _N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError("data_file is required")
+        self.data = []
+        want_train = self.mode == "train"
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [m for m in tf.getmembers() if self._want(m.name, want_train)]
+            for m in sorted(names, key=lambda m: m.name):
+                batch = pickle.load(tf.extractfile(m), encoding="bytes")
+                images = batch[b"data"]
+                labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                for img, lab in zip(images, labels):
+                    self.data.append((img.reshape(3, 32, 32).transpose(1, 2, 0),
+                                      np.int64(lab)))
+
+    def _want(self, name, train):
+        base = os.path.basename(name)
+        if train:
+            return base.startswith("data_batch")
+        return base == "test_batch"
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _N_CLASSES = 100
+
+    def _want(self, name, train):
+        base = os.path.basename(name)
+        return base == ("train" if train else "test")
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+             ".tiff", ".webp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(f"loading {path} needs PIL; save images as .npy "
+                           f"or pass a custom loader") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (folder.py:DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = extensions or _IMG_EXTS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat (label-less) image folder (folder.py:ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = extensions or _IMG_EXTS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,131 @@
+"""MobileNetV3 small/large with squeeze-excitation
+(reference: python/paddle/vision/models/mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, channels, squeeze_factor=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // squeeze_factor)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.hsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsigmoid(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class ConvNormAct(nn.Sequential):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, groups=1, act="hardswish"):
+        padding = (kernel - 1) // 2
+        layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                            padding=padding, groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(out_ch)]
+        if act == "hardswish":
+            layers.append(nn.Hardswish())
+        elif act == "relu":
+            layers.append(nn.ReLU())
+        super().__init__(*layers)
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, inp, hidden, oup, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if hidden != inp:
+            layers.append(ConvNormAct(inp, hidden, 1, act=act))
+        layers.append(ConvNormAct(hidden, hidden, kernel, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(hidden))
+        layers.append(ConvNormAct(hidden, oup, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_SMALL = [  # kernel, hidden, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        layers = [ConvNormAct(3, in_ch, 3, stride=2, act="hardswish")]
+        for k, hidden, out, se, act, s in cfg:
+            hidden = _make_divisible(hidden * scale)
+            out = _make_divisible(out * scale)
+            layers.append(InvertedResidualV3(in_ch, hidden, out, k, s, se, act))
+            in_ch = out
+        last_conv = _make_divisible(6 * in_ch)
+        layers.append(ConvNormAct(in_ch, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights need a download source")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights need a download source")
+    return MobileNetV3Large(scale=scale, **kwargs)

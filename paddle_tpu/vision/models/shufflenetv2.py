@@ -1,0 +1,134 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import concat, nn, reshape, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _conv_bn_act(in_ch, out_ch, kernel, stride=1, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=kernel // 2, groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidualUnit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(in_ch // 2, branch_ch, 1, act=act),
+                _conv_bn_act(branch_ch, branch_ch, 3, stride=stride,
+                             groups=branch_ch, act=None),
+                _conv_bn_act(branch_ch, branch_ch, 1, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn_act(in_ch, in_ch, 3, stride=stride, groups=in_ch,
+                             act=None),
+                _conv_bn_act(in_ch, branch_ch, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(in_ch, branch_ch, 1, act=act),
+                _conv_bn_act(branch_ch, branch_ch, 3, stride=stride,
+                             groups=branch_ch, act=None),
+                _conv_bn_act(branch_ch, branch_ch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_chs = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn_act(3, out_chs[0], 3, stride=2, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_ch = out_chs[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_ch = out_chs[stage + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidualUnit(
+                    in_ch, out_ch, 2 if i == 0 else 1, act=act))
+                in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _conv_bn_act(in_ch, out_chs[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_chs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights need a download source")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kw)

@@ -1,0 +1,11 @@
+from . import functional  # noqa: F401
+from .functional import (adjust_brightness, adjust_contrast, adjust_hue,  # noqa: F401
+                         adjust_saturation, center_crop, crop, erase, hflip,
+                         normalize, pad, resize, rotate, to_grayscale,
+                         to_tensor, vflip)
+from .transforms import (BaseTransform, BrightnessTransform, CenterCrop,  # noqa: F401
+                         ColorJitter, Compose, ContrastTransform, Grayscale,
+                         HueTransform, Normalize, Pad, RandomCrop,
+                         RandomErasing, RandomHorizontalFlip,
+                         RandomResizedCrop, RandomRotation, RandomVerticalFlip,
+                         Resize, SaturationTransform, ToTensor, Transpose)

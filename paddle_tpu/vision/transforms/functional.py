@@ -1,0 +1,267 @@
+"""Functional image transforms over numpy HWC arrays (and Tensors).
+
+Reference: python/paddle/vision/transforms/functional.py — that file dispatches
+to PIL/cv2/tensor backends; here the single backend is numpy (HWC, uint8 or
+float32), which XLA-jitted pipelines consume via `to_tensor`. PIL images are
+accepted and converted when PIL is importable.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _to_numpy(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img.numpy())
+    if isinstance(img, np.ndarray):
+        return img
+    # PIL duck-typing: anything with .convert/.size
+    if hasattr(img, "convert") and hasattr(img, "size"):
+        return np.asarray(img)
+    raise TypeError(f"unsupported image type {type(img)}")
+
+
+def to_tensor(pic, data_format="CHW"):
+    """uint8 HWC -> float32 [0,1] CHW Tensor (functional.py:to_tensor)."""
+    import jax.numpy as jnp
+
+    arr = _to_numpy(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr), stop_gradient=True)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Resize HWC image. XLA-free host path: numpy bilinear/nearest."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        # shorter side -> size, keep aspect (reference semantics)
+        if h < w:
+            oh, ow = size, max(1, int(size * w / h))
+        else:
+            oh, ow = max(1, int(size * h / w)), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        out = arr
+    elif interpolation == "nearest":
+        ri = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+        ci = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+        out = arr[ri][:, ci]
+    else:  # bilinear
+        ry = (np.arange(oh) + 0.5) * h / oh - 0.5
+        cx = (np.arange(ow) + 0.5) * w / ow - 0.5
+        ry = ry.clip(0, h - 1)
+        cx = cx.clip(0, w - 1)
+        y0 = np.floor(ry).astype(np.int64)
+        x0 = np.floor(cx).astype(np.int64)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ry - y0)[:, None, None]
+        wx = (cx - x0)[None, :, None]
+        a = arr.astype(np.float32)
+        out = (a[y0][:, x0] * (1 - wy) * (1 - wx) + a[y1][:, x0] * wy * (1 - wx)
+               + a[y0][:, x1] * (1 - wy) * wx + a[y1][:, x1] * wy * wx)
+        if arr.dtype == np.uint8:
+            out = np.round(out).clip(0, 255).astype(np.uint8)
+        else:
+            out = out.astype(arr.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def crop(img, top, left, height, width):
+    arr = _to_numpy(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_numpy(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, pads, mode="constant", constant_values=fill)
+    return np.pad(arr, pads, mode={"edge": "edge", "reflect": "reflect",
+                                   "symmetric": "symmetric"}[padding_mode])
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotation via inverse-mapped nearest/bilinear sampling (host numpy)."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    cy, cx = center if center is not None else ((h - 1) / 2.0, (w - 1) / 2.0)
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        corners = np.array([[-cy, -cx], [-cy, w - 1 - cx],
+                            [h - 1 - cy, -cx], [h - 1 - cy, w - 1 - cx]])
+        ys = corners[:, 0] * cos - corners[:, 1] * sin
+        xs = corners[:, 0] * sin + corners[:, 1] * cos
+        oh = int(np.ceil(ys.max() - ys.min())) + 1
+        ow = int(np.ceil(xs.max() - xs.min())) + 1
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    yy, xx = np.meshgrid(np.arange(oh) - ocy, np.arange(ow) - ocx, indexing="ij")
+    # inverse rotation back into source coords
+    sy = yy * cos + xx * sin + cy
+    sx = -yy * sin + xx * cos + cx
+    valid = (sy >= 0) & (sy <= h - 1) & (sx >= 0) & (sx <= w - 1)
+    sy_c = sy.clip(0, h - 1)
+    sx_c = sx.clip(0, w - 1)
+    if interpolation == "bilinear":
+        y0, x0 = np.floor(sy_c).astype(int), np.floor(sx_c).astype(int)
+        y1, x1 = np.minimum(y0 + 1, h - 1), np.minimum(x0 + 1, w - 1)
+        wy, wx = (sy_c - y0)[..., None], (sx_c - x0)[..., None]
+        a = arr.astype(np.float32)
+        out = (a[y0, x0] * (1 - wy) * (1 - wx) + a[y1, x0] * wy * (1 - wx)
+               + a[y0, x1] * (1 - wy) * wx + a[y1, x1] * wy * wx)
+    else:
+        out = arr[np.round(sy_c).astype(int), np.round(sx_c).astype(int)].astype(np.float32)
+    out = np.where(valid[..., None], out, np.float32(fill))
+    out = out.round().clip(0, 255).astype(np.uint8) if arr.dtype == np.uint8 \
+        else out.astype(arr.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def adjust_brightness(img, factor):
+    arr = _to_numpy(img).astype(np.float32)
+    out = arr * factor
+    return _restore(out, img)
+
+
+def adjust_contrast(img, factor):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = _grayscale(arr).mean()
+    out = (arr - mean) * factor + mean
+    return _restore(out, img)
+
+
+def adjust_saturation(img, factor):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = _grayscale(arr)[..., None]
+    out = (arr - gray) * factor + gray
+    return _restore(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV roundtrip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_numpy(img).astype(np.float32) / 255.0
+    mx, mn = arr.max(-1), arr.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    hue = np.where(mx == r, ((g - b) / diff) % 6,
+                   np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6.0
+    hue = (hue + hue_factor) % 1.0
+    sat = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    val = mx
+    # hsv -> rgb
+    i = np.floor(hue * 6).astype(int) % 6
+    f = hue * 6 - np.floor(hue * 6)
+    p = val * (1 - sat)
+    q = val * (1 - f * sat)
+    t_ = val * (1 - (1 - f) * sat)
+    choices = [np.stack(c, -1) for c in
+               [(val, t_, p), (q, val, p), (p, val, t_),
+                (p, q, val), (t_, p, val), (val, p, q)]]
+    out = np.select([np.repeat((i == k)[..., None], 3, -1) for k in range(6)],
+                    choices)
+    return _restore(out * 255.0, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = _grayscale(arr)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _restore(out, img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img.numpy())
+    else:
+        arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(out), stop_gradient=True)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    if isinstance(img, Tensor):
+        from ... import ops
+
+        out = img if inplace else Tensor(img._data, stop_gradient=img.stop_gradient)
+        data = out._data.at[..., i:i + h, j:j + w].set(
+            out._data.dtype.type(0) if np.isscalar(v) else v)
+        out._data = data
+        return out
+    arr = _to_numpy(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _grayscale(arr):
+    if arr.shape[-1] == 1:
+        return arr[..., 0]
+    return arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+
+
+def _restore(out, orig):
+    arr = _to_numpy(orig)
+    if arr.dtype == np.uint8:
+        return np.round(out).clip(0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
