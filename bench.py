@@ -92,6 +92,140 @@ def _count_pallas_calls(jitted_step, *args) -> int:
         return -1
 
 
+def _eager_microbench():
+    """Eager per-op dispatch cost (SURVEY §7.3 hard-part #1): µs/op for
+    cache-hit dispatch with grad off/on, warm-backward µs/op, and the
+    eager-vs-compiled train-step ratio on llama_tiny. The reference keeps this
+    path native (`phi/core/kernel_factory.cc:270`); here it is a Python dict
+    lookup + jitted-executable call, so it must be measured, not assumed."""
+    import time
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    out = {}
+    a = paddle.to_tensor(np.ones((1024, 1024), np.float32))
+    b = paddle.to_tensor(np.ones((1024, 1024), np.float32))
+    s = paddle.to_tensor(np.ones((8, 8), np.float32))
+    t = paddle.to_tensor(np.ones((8, 8), np.float32))
+    for x in (a, b, s, t):
+        x.stop_gradient = True
+
+    def us_per_op(op, x, y, n):
+        op(x, y)._data.block_until_ready()  # warm the executable cache
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = op(x, y)
+        r._data.block_until_ready()
+        return (time.perf_counter() - t0) / n * 1e6
+
+    mul = lambda x, y: x * y  # noqa: E731
+    mm = lambda x, y: x @ y  # noqa: E731
+    out["nograd_tiny_add_us"] = round(us_per_op(lambda x, y: x + y, s, t, 2000), 1)
+    out["nograd_1k_matmul_us"] = round(us_per_op(mm, a, b, 200), 1)
+    a.stop_gradient = s.stop_gradient = False
+    out["grad_tiny_add_us"] = round(us_per_op(lambda x, y: x + y, s, t, 2000), 1)
+    out["grad_tiny_mul_us"] = round(us_per_op(mul, s, t, 2000), 1)
+    out["grad_tiny_matmul_us"] = round(us_per_op(mm, s, t, 2000), 1)
+    out["grad_1k_matmul_us"] = round(us_per_op(mm, a, b, 200), 1)
+    out["dispatch_ops_per_sec"] = round(1e6 / out["grad_tiny_mul_us"])
+
+    # warm backward: 100-op chain, second run (first pays one-time jit traces)
+    def chain_backward():
+        s.clear_gradient()
+        w = s
+        for _ in range(100):
+            w = w * t
+        loss = w.sum()
+        t0 = time.perf_counter()
+        loss.backward()
+        s._grad._data.block_until_ready()
+        return (time.perf_counter() - t0) / 101 * 1e6
+
+    chain_backward()
+    out["backward_us_per_op"] = round(chain_backward(), 1)
+
+    # eager vs compiled train step on llama_tiny
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import functional_call, state_arrays
+    from paddle_tpu.models import llama_tiny
+
+    model = llama_tiny(seq=128)
+    model.train()
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    ids_np = rng.integers(0, V, (2, 128))
+    lab_np = rng.integers(0, V, (2, 128))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    # pre-staged device tensors: both legs measure fwd+bwd+AdamW only, no
+    # per-step host->device transfer on either side
+    ids_t, lab_t = paddle.to_tensor(ids_np), paddle.to_tensor(lab_np)
+
+    def eager_step():
+        loss, _ = model(ids_t, labels=lab_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    eager_step()  # warm executable caches
+    t0 = time.perf_counter()
+    for _ in range(3):
+        loss = eager_step()
+    loss._data.block_until_ready()
+    eager_ms = (time.perf_counter() - t0) / 3 * 1e3
+
+    params = state_arrays(model)
+    m_st = {k: jax.numpy.zeros_like(v) for k, v in params.items()}
+    v_st = {k: jax.numpy.zeros_like(v) for k, v in params.items()}
+
+    def compiled_step(params, m_st, v_st, step, ids, labels):
+        def loss_fn(p):
+            loss, _ = functional_call(model, p, Tensor(ids),
+                                      labels=Tensor(labels))
+            return loss._data
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # the same AdamW update the eager leg's optimizer performs
+        b1, b2, lr, eps, wd = 0.9, 0.999, 1e-4, 1e-8, 0.01
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            g = grads[k]
+            new_m[k] = b1 * m_st[k] + (1 - b1) * g
+            new_v[k] = b2 * v_st[k] + (1 - b2) * g * g
+            mhat = new_m[k] / (1 - b1 ** step)
+            vhat = new_v[k] / (1 - b2 ** step)
+            new_p[k] = params[k] - lr * (
+                mhat / (jax.numpy.sqrt(vhat) + eps) + wd * params[k])
+        return loss, new_p, new_m, new_v
+
+    jstep = jax.jit(compiled_step)
+
+    def step_fn(params, ids, labels):
+        nonlocal m_st, v_st, _step
+        _step += 1.0
+        loss, params, m_st, v_st = jstep(params, m_st, v_st, _step, ids,
+                                         labels)
+        return loss, params
+
+    _step = 0.0
+    ids_j, lab_j = jax.numpy.asarray(ids_np), jax.numpy.asarray(lab_np)
+    loss, params = step_fn(params, ids_j, lab_j)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        loss, params = step_fn(params, ids_j, lab_j)
+    jax.block_until_ready(loss)
+    compiled_ms = (time.perf_counter() - t0) / 3 * 1e3
+    out["llama_tiny_eager_step_ms"] = round(eager_ms, 2)
+    out["llama_tiny_compiled_step_ms"] = round(compiled_ms, 2)
+    out["eager_vs_compiled_ratio"] = round(eager_ms / max(compiled_ms, 1e-9), 1)
+    return out
+
+
 def main():
     extras = {}
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
@@ -183,37 +317,81 @@ def main():
         return model, train_step, params, m_state, v_state
 
     rng = np.random.default_rng(0)
+
+    def run_config(n_layers, batch, remat, count_pallas=False,
+                   breakdown=False):
+        """Measure one (layers, batch, remat) config; returns
+        (model, dt_seconds, loss, breakdown_dict|None). Raises on OOM."""
+        model, train_step, params, m_state, v_state = build(
+            n_layers, batch, remat)
+        ids = jnp.asarray(rng.integers(0, base_cfg["vocab_size"],
+                                       (batch, seq)))
+        labels = jnp.asarray(rng.integers(0, base_cfg["vocab_size"],
+                                          (batch, seq)))
+        bd = None
+        if breakdown:
+            # profiler-style step decomposition: time fwd-only, fwd+bwd, and
+            # the full step as separate jitted programs; bwd/opt come out by
+            # subtraction (BASELINE.md protocol "step time breakdown").
+            from paddle_tpu.core.tensor import Tensor
+            from paddle_tpu.jit import functional_call
+
+            def fwd_only(params, ids, labels):
+                loss, _ = functional_call(model, params, Tensor(ids),
+                                          labels=Tensor(labels))
+                return loss._data.astype(jnp.float32)
+
+            def fwd_bwd(params, ids, labels):
+                return jax.value_and_grad(
+                    lambda p: fwd_only(p, ids, labels))(params)
+
+            def timeit(fn, *args, reps=5):
+                r = fn(*args)
+                jax.block_until_ready(r)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    r = fn(*args)
+                jax.block_until_ready(r)
+                return (time.perf_counter() - t0) / reps * 1e3
+
+            fwd_ms = timeit(jax.jit(fwd_only), params, ids, labels)
+            fwdbwd_ms = timeit(jax.jit(fwd_bwd), params, ids, labels)
+            bd = {"fwd_ms": round(fwd_ms, 1),
+                  "bwd_ms": round(fwdbwd_ms - fwd_ms, 1)}
+        step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        if count_pallas:
+            extras["pallas_custom_calls"] = _count_pallas_calls(
+                step_fn, params, m_state, v_state, 1.0, ids, labels)
+        loss, params, m_state, v_state = step_fn(
+            params, m_state, v_state, 1.0, ids, labels)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss, params, m_state, v_state = step_fn(
+                params, m_state, v_state, float(i + 2), ids, labels)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        if bd is not None:
+            # by-subtraction estimate across two separately compiled programs
+            # (the full step is donated/fused differently): clamp at 0 and
+            # mark the method so a near-zero optimizer share reads as such.
+            bd["opt_ms_by_subtraction"] = round(max(0.0, dt * 1e3 - fwdbwd_ms), 1)
+            bd["step_ms"] = round(dt * 1e3, 1)
+        return model, dt, float(loss), bd
+
     result = None
     for (n_layers, batch, remat) in tries:
         try:
-            model, train_step, params, m_state, v_state = build(
-                n_layers, batch, remat)
-            ids = jnp.asarray(rng.integers(0, base_cfg["vocab_size"],
-                                           (batch, seq)))
-            labels = jnp.asarray(rng.integers(0, base_cfg["vocab_size"],
-                                              (batch, seq)))
-            step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
-            if on_tpu:
-                extras["pallas_custom_calls"] = _count_pallas_calls(
-                    step_fn, params, m_state, v_state, 1.0, ids, labels)
-            loss, params, m_state, v_state = step_fn(
-                params, m_state, v_state, 1.0, ids, labels)
-            jax.block_until_ready(loss)
-            t0 = time.perf_counter()
-            for i in range(steps):
-                loss, params, m_state, v_state = step_fn(
-                    params, m_state, v_state, float(i + 2), ids, labels)
-            jax.block_until_ready(loss)
-            dt = (time.perf_counter() - t0) / steps
-            result = (model, n_layers, batch, remat, dt, float(loss))
+            model, dt, loss_val, bd = run_config(
+                n_layers, batch, remat, count_pallas=on_tpu, breakdown=on_tpu)
+            if bd:
+                extras["step_breakdown_ms"] = bd
+            result = (model, n_layers, batch, remat, dt, loss_val)
             break
         except Exception as e:  # RESOURCE_EXHAUSTED etc: try smaller
             extras.setdefault("config_fallbacks", []).append(
                 {"config": [n_layers, batch, remat],
                  "error": f"{type(e).__name__}: {str(e)[:200]}"})
-            # drop the failed attempt's device state before the next build
-            model = train_step = params = m_state = v_state = None
-            step_fn = ids = labels = None
             import gc
 
             gc.collect()
@@ -229,8 +407,39 @@ def main():
     model, n_layers, batch, remat, dt, loss_v = result
     tokens_per_sec = batch * seq / dt
     mfu = tokens_per_sec * model.flops_per_token(seq) / _peak_flops(dev)
-    # release the training state before the microbench allocates
-    del params, m_state, v_state, step_fn
+    import gc
+
+    gc.collect()  # release the training state before further measurements
+
+    # Remat-on / deeper-model companion measurement: the remat-on number is
+    # what predicts large-pod behavior where activations cannot be held
+    # (round-3 VERDICT weak-item 2). Measured only when the headline config
+    # ran remat-off.
+    if on_tpu and not remat:
+        remat_tries = ([(24, 4, True), (16, 4, True)] if extras.get(
+            "hbm_bytes", 0) >= 90 << 30 else [(8, 2, True), (4, 2, True)])
+        for (rl, rb, _) in remat_tries:
+            try:
+                rmodel, rdt, rloss, _bd = run_config(rl, rb, True)
+                rtps = rb * seq / rdt
+                rmfu = rtps * rmodel.flops_per_token(seq) / _peak_flops(dev)
+                extras["remat_on_mfu"] = {
+                    "mfu": round(float(rmfu), 4), "layers": rl, "batch": rb,
+                    "tokens_per_sec": round(rtps), "loss": round(rloss, 3)}
+                del rmodel
+                gc.collect()
+                break
+            except Exception as e:
+                extras.setdefault("remat_fallbacks", []).append(
+                    {"config": [rl, rb], "error": f"{type(e).__name__}: {str(e)[:160]}"})
+                gc.collect()
+
+    # Eager dispatch microbench (round-3 VERDICT weak-item 1)
+    try:
+        extras["eager_dispatch"] = _eager_microbench()
+    except Exception as e:
+        extras["eager_dispatch"] = f"{type(e).__name__}: {str(e)[:160]}"
+    gc.collect()
 
     # flash-vs-sdpa microbench on the measured attention shape
     if on_tpu:

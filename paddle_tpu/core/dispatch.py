@@ -113,17 +113,14 @@ def _canon_attr(v):
 
 
 def _attr_key(attrs: dict) -> tuple:
+    if not attrs:
+        return ()
     return tuple(sorted((k, _canon_attr(v)) for k, v in attrs.items()))
 
 
 def _aval_key(arrays) -> tuple:
-    out = []
-    for a in arrays:
-        if a is None:
-            out.append(None)
-        else:
-            out.append((tuple(a.shape), str(a.dtype)))
-    return tuple(out)
+    # hot path: np.dtype objects hash/compare fine — no str() conversion
+    return tuple(None if a is None else (a.shape, a.dtype) for a in arrays)
 
 
 @functools.lru_cache(maxsize=1)
@@ -134,8 +131,12 @@ def _jax():
 
 
 def _is_tracer(x) -> bool:
-    jax = _jax()
-    return isinstance(x, jax.core.Tracer)
+    return isinstance(x, _tracer_cls())
+
+
+@functools.lru_cache(maxsize=1)
+def _tracer_cls():
+    return _jax().core.Tracer
 
 
 def _log_compile(kind, name, key):
@@ -243,34 +244,64 @@ def apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     return _apply(op_name, tensor_inputs, attrs)
 
 
+_Tensor = None
+
+
+def _tensor_cls():
+    global _Tensor
+    if _Tensor is None:
+        from .tensor import Tensor
+
+        _Tensor = Tensor
+    return _Tensor
+
+
 def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
-    from .tensor import Tensor
+    Tensor = _Tensor or _tensor_cls()
 
     op = _OP_REGISTRY[op_name]
     attrs = attrs or {}
     if _amp_hook is not None:
         tensor_inputs = _amp_hook(op_name, tensor_inputs)
-    arrays = [t._data if isinstance(t, Tensor) else t for t in tensor_inputs]
+
+    # One scan over the inputs: unwrap arrays, detect tracers, build the
+    # per-slot differentiability mask (the reference folds this into the
+    # generated ad_func prologue, `eager_gen.py:1887`).
+    Tracer = _tracer_cls()
+    arrays = []
+    mask = []
+    has_tracer = False
+    any_live = False
+    for t in tensor_inputs:
+        if isinstance(t, Tensor):
+            a = t._data
+            arrays.append(a)
+            if isinstance(a, Tracer):
+                has_tracer = True
+            live = not t.stop_gradient
+            if live:
+                any_live = True
+            mask.append(live and _differentiable(a))
+        else:
+            arrays.append(t)
+            mask.append(False)
+            if isinstance(t, Tracer):
+                has_tracer = True
 
     # Graph-capture path: inside jax tracing there is no tape; call through.
-    if any(_is_tracer(a) for a in arrays if a is not None):
+    if has_tracer:
         out = op.fn(*arrays, **attrs)
-        sg = not (autograd.is_grad_enabled() and any(
-            isinstance(t, Tensor) and not t.stop_gradient for t in tensor_inputs))
+        sg = not (autograd.is_grad_enabled() and any_live)
         return _wrap_traced(op, out, sg)
 
-    requires = autograd.is_grad_enabled() and any(
-        isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data)
-        for t in tensor_inputs)
+    requires = any(mask) and autograd.is_grad_enabled()
 
     if not requires:
         fn = _get_fwd(op, attrs, arrays)
         out = fn(*arrays)
         return _wrap(op, out, stop_gradient=True)
 
-    mask = tuple(
-        isinstance(t, Tensor) and not t.stop_gradient and _differentiable(t._data)
-        for t in tensor_inputs)
+    mask = tuple(mask)
     fn = _get_fwd_vjp(op, attrs, arrays, mask)
     out, vjp_fn = fn(*arrays)
 
@@ -279,7 +310,7 @@ def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
 
     node = autograd.OpGradNode(op.name, len(outs), vjp_fn, mask, out_is_tuple,
                                _vjp_caller())
-    node.out_avals = [(tuple(o.shape), np.dtype(o.dtype)) for o in outs]
+    node.out_avals = [(o.shape, o.dtype) for o in outs]
     # TensorWrapper analog (`fluid/eager/tensor_wrapper.h:39`): snapshot the
     # primal inputs + attrs so grad(create_graph=True) can re-execute this
     # node's backward as taped eager ops (vjp-of-vjp). Stored as

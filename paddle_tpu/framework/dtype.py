@@ -133,14 +133,27 @@ def to_np(dtype) -> np.dtype:
 _EXT_FLOAT_NAMES = ("bfloat16", "float8_e4m3fn", "float8_e5m2")
 
 
+_floating_cache: dict = {}
+_inexact_cache: dict = {}
+
+
 def is_floating_np(dt) -> bool:
-    dt = np.dtype(dt)
-    return np.issubdtype(dt, np.floating) or dt.name in _EXT_FLOAT_NAMES
+    r = _floating_cache.get(dt)
+    if r is None:
+        d = np.dtype(dt)
+        r = _floating_cache[dt] = bool(
+            np.issubdtype(d, np.floating) or d.name in _EXT_FLOAT_NAMES)
+    return r
 
 
 def is_inexact_np(dt) -> bool:
-    dt = np.dtype(dt)
-    return np.issubdtype(dt, np.inexact) or dt.name in _EXT_FLOAT_NAMES
+    # dispatch hot path: memoized per dtype object (np.dtype/str both hashable)
+    r = _inexact_cache.get(dt)
+    if r is None:
+        d = np.dtype(dt)
+        r = _inexact_cache[dt] = bool(
+            np.issubdtype(d, np.inexact) or d.name in _EXT_FLOAT_NAMES)
+    return r
 
 
 # paddle-style default dtype state (reference: python/paddle/base/framework.py

@@ -58,17 +58,10 @@ row_stack = vstack
 def _split_family(name, axis_of):
     def api(x, num_or_indices=None, name_=None):
         x = as_tensor(x)
-        from .manipulation import split
-
         axis = axis_of(x)
-        if isinstance(num_or_indices, int):
-            return split(x, num_or_indices, axis=axis)
-        # indices are split points -> section sizes
-        pts = list(num_or_indices)
-        dim = x.shape[axis]
-        bounds = [0] + [int(p) for p in pts] + [dim]
-        sections = [bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)]
-        return split(x, sections, axis=axis)
+        # Reference defines h/v/dsplit as tensor_split equivalents: the int case
+        # allows non-divisible dims (sections [4,3,3] for 10/3), unlike split().
+        return tensor_split(x, num_or_indices, axis=axis)
 
     api.__name__ = name
     return api
@@ -144,6 +137,10 @@ def unfold(x, axis, size, step, name=None):
     x = as_tensor(x)
     axis = normalize_axis(axis, x.ndim)
     dim = x.shape[axis]
+    if step <= 0:
+        raise ValueError(f"unfold: step must be positive, got {step}")
+    if size <= 0 or size > dim:
+        raise ValueError(f"unfold: size ({size}) must be in [1, {dim}] for dim {axis}")
     n_win = (dim - size) // step + 1
     _reg("unfold_axis", lambda x, *, axis, size, step, n_win: _unfold_impl(x, axis, size, step, n_win))
     return dispatch.apply("unfold_axis", [x],
@@ -217,9 +214,18 @@ def reverse(x, axis, name=None):
 
 def take(x, index, mode="raise", name=None):
     x, index = as_tensor(x), as_tensor(index)
-    _reg("take_flat", lambda x, i, *, mode: jnp.take(
-        x.reshape(-1), i if mode != "wrap" else i % x.size,
-        mode="clip" if mode != "wrap" else None))
+
+    def impl(x, i, *, mode):
+        flat = x.reshape(-1)
+        if mode == "wrap":
+            i = i % flat.size
+        else:
+            # 'raise'/'clip': negatives wrap from the end (reference take());
+            # remaining OOB clamps — 'raise' approximated by clip under jit.
+            i = jnp.where(i < 0, i + flat.size, i)
+        return jnp.take(flat, i, mode=None if mode == "wrap" else "clip")
+
+    _reg("take_flat", impl)
     return dispatch.apply("take_flat", [x, index], {"mode": str(mode)})
 
 

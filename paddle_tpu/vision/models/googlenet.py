@@ -1,5 +1,5 @@
 """GoogLeNet / InceptionV1 (reference: python/paddle/vision/models/googlenet.py).
-Returns (main, aux1, aux2) logits in train mode like the reference."""
+Returns (main, aux1, aux2) logits unconditionally like the reference."""
 from __future__ import annotations
 
 from ... import concat, nn
@@ -79,9 +79,11 @@ class GoogLeNet(nn.Layer):
         x = self.stem(x)
         x = self.pool3(self.inc3b(self.inc3a(x)))
         x = self.inc4a(x)
-        aux1 = self.aux1(x) if self.training else None
+        # Reference GoogLeNet returns (out, aux1, aux2) unconditionally
+        # (vision/models/googlenet.py), so downstream unpacking works in eval too.
+        aux1 = self.aux1(x)
         x = self.inc4d(self.inc4c(self.inc4b(x)))
-        aux2 = self.aux2(x) if self.training else None
+        aux2 = self.aux2(x)
         x = self.pool4(self.inc4e(x))
         x = self.inc5b(self.inc5a(x))
         if self.with_pool:
@@ -89,9 +91,7 @@ class GoogLeNet(nn.Layer):
         x = self.dropout(x).flatten(1)
         if self.num_classes > 0:
             x = self.fc(x)
-        if self.training:
-            return x, aux1, aux2
-        return x
+        return x, aux1, aux2
 
 
 def googlenet(pretrained=False, **kwargs):

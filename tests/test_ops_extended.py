@@ -36,6 +36,12 @@ class TestStackSplit:
             check(mine, ref)
         parts = paddle.tensor_split(t(np.arange(10.0)), 3)
         assert [p.shape[0] for p in parts] == [4, 3, 3]
+        # h/v/dsplit are tensor_split equivalents: non-divisible ints allowed
+        parts = paddle.hsplit(t(np.arange(10.0)), 3)
+        assert [p.shape[0] for p in parts] == [4, 3, 3]
+        y = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        parts = paddle.dsplit(t(y), 3)
+        assert [p.shape[2] for p in parts] == [2, 1, 1]
 
     def test_atleast_block_diag(self):
         assert paddle.atleast_2d(t(3.0)).shape == [1, 1]
@@ -115,6 +121,10 @@ class TestSearchCumulative:
     def test_take_trace(self):
         x = np.arange(12, dtype=np.float32).reshape(3, 4)
         check(paddle.take(t(x), t([0, 5, 11])), [0, 5, 11])
+        # negative indices wrap from the end in every mode (reference take())
+        check(paddle.take(t(x), t([-1, -12])), [11, 0])
+        check(paddle.take(t(x), t([-1, 13]), mode="wrap"), [11, 1])
+        check(paddle.take(t(x), t([-1, 99]), mode="clip"), [11, 11])
         check(paddle.trace(t(x)), np.trace(x))
         check(paddle.trace(t(x), offset=1), np.trace(x, offset=1))
 
