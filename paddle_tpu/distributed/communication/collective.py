@@ -393,28 +393,58 @@ _mailbox = {}
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point send. SINGLE-CONTROLLER ONLY: the one process plays
-    every rank, so values queue per group and `recv(src=...)` pops them
-    FIFO regardless of the declared src/dst ranks. Under real multi-process
-    execution this mailbox cannot reach other processes — use the in-graph
-    p2p (`p2p_shift`/ppermute, what pipeline schedules build on) or an
-    object collective instead; calling it there raises."""
+    """Point-to-point send (reference `dist.send`,
+    `phi/core/distributed/collective/process_group.h:326`).
+
+    Multi-process: real cross-process transport over the coordination
+    service KV store (see `p2p.py`) — buffered send, matched-order channel
+    semantics like NCCL p2p. `dst` is the global process rank.
+
+    Single-controller: the one process plays every rank, so values queue
+    per group and `recv(src=...)` pops them FIFO regardless of the declared
+    src/dst ranks."""
     import collections
 
     if _multiproc():
-        raise NotImplementedError(
-            "eager send/recv is a single-controller mailbox; under "
-            "multi-process launch use p2p_shift (in-graph ppermute) or "
-            "all_gather/broadcast_object_list")
+        import jax
+
+        from . import p2p
+
+        p2p.mp_send(tensor._data, jax.process_index(), int(dst),
+                    _group(group).id)
+        return _FinishedTask(tensor)
     key = _group(group).id
     _mailbox.setdefault(key, collections.deque()).append(tensor._data)
     return _FinishedTask(tensor)
 
 
+def _check_recv_match(tensor: Tensor, arr, src):
+    """Reference recv errors when numel/dtype disagree with the destination
+    (`process_group.h` Recv); a silent rebind would surface far from the
+    comm bug."""
+    want_shape = tuple(int(s) for s in tensor._data.shape)
+    got_shape = tuple(int(s) for s in arr.shape)
+    want_dt, got_dt = str(tensor._data.dtype), str(np.dtype(arr.dtype).name)
+    if want_shape != got_shape or want_dt != got_dt:
+        raise RuntimeError(
+            f"recv(src={src}): payload {got_shape}/{got_dt} does not match "
+            f"destination tensor {want_shape}/{want_dt} — mismatched "
+            "send/recv pair or channel slipped out of matched order")
+
+
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    """Blocking point-to-point receive into `tensor` (reference `dist.recv`).
+    `src` is the global process rank under multi-process execution."""
     if _multiproc():
-        raise NotImplementedError(
-            "eager send/recv is a single-controller mailbox (see send)")
+        import jax
+        import jax.numpy as jnp
+
+        from . import p2p
+
+        arr = p2p.mp_recv(int(src), jax.process_index(), _group(group).id)
+        _check_recv_match(tensor, arr, src)
+        tensor._data = jnp.asarray(arr)
+        return _FinishedTask(tensor)
     queue = _mailbox.get(_group(group).id)
     if not queue:
         raise RuntimeError(
@@ -425,8 +455,58 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     return _FinishedTask(tensor)
 
 
-isend = send
-irecv = recv
+isend = send  # send is buffered, hence already non-blocking
+
+
+class _PendingRecv:
+    """Task handle for a non-blocking irecv: the fetch runs on a worker
+    thread; wait() joins and re-raises transport/validation errors."""
+
+    def __init__(self, tensor, thread, box):
+        self._tensor = tensor
+        self._thread = thread
+        self._box = box
+
+    def wait(self):
+        self._thread.join()
+        if "err" in self._box:
+            raise self._box["err"]
+        return self._tensor
+
+    def is_completed(self):
+        return not self._thread.is_alive()
+
+
+def irecv(tensor: Tensor, src=0, group=None, sync_op=False):
+    """Non-blocking receive (NCCL irecv semantics): posts the receive and
+    returns a waitable task, so recv-before-send patterns
+    (batch_isend_irecv) complete instead of deadlocking."""
+    if not _multiproc():
+        return recv(tensor, src=src, group=group)
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import p2p
+
+    gid = _group(group).id
+    me = jax.process_index()
+    # claim the channel slot NOW so several outstanding irecvs keep order
+    seq = p2p._next_seq(gid, int(src), me)
+    box = {}
+
+    def work():
+        try:
+            arr = p2p.mp_recv(int(src), me, gid, seq=seq)
+            _check_recv_match(tensor, arr, src)
+            tensor._data = jnp.asarray(arr)
+        except Exception as e:  # surfaced on wait()
+            box["err"] = e
+
+    th = threading.Thread(target=work, daemon=True)
+    th.start()
+    return _PendingRecv(tensor, th, box)
 
 
 class P2POp:

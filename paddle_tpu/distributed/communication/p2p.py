@@ -1,0 +1,130 @@
+"""Cross-process eager point-to-point transport.
+
+Analog of the reference ProcessGroup::Send/Recv
+(`phi/core/distributed/collective/process_group.h:326-386`) and the PP p2p
+layer (`fleet/meta_parallel/pp_utils/p2p_communication.py:51`). The reference
+rides NCCL; the TPU-native transport is the JAX/PJRT coordination service
+(the same DCN channel `jax.distributed.initialize` rendezvouses over): the
+sender serializes the array and publishes it under a
+``(group, src->dst, seq)`` key, the receiver blocks on that key, reassembles,
+and deletes it.
+
+This is the *eager* path that unblocks cross-process pipeline schedules and
+control traffic. Bulk/perf traffic inside compiled programs should keep using
+the in-graph p2p (`ppermute` via `p2p_shift` / `scan_pipeline`), which rides
+ICI.
+
+Semantics match NCCL p2p where it matters: sends and recvs on one
+``(src, dst, group)`` channel must be issued in matching order on both sides
+(each side keeps a lock-step sequence counter). send() is buffered
+(fire-and-forget into the KV store); recv() blocks with the comm watchdog
+timeout.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ...framework import flags
+
+# Stay well under the coordination service's gRPC frame limit.
+_CHUNK_BYTES = 2 << 20
+
+_seq_lock = threading.Lock()
+_seq: Dict[Tuple[int, int, int], int] = {}
+
+
+def _client():
+    from jax._src import distributed
+
+    c = distributed.global_state.client
+    if c is None:
+        raise RuntimeError(
+            "cross-process p2p needs a live coordination service; start "
+            "workers via `python -m paddle_tpu.distributed.launch` (or call "
+            "jax.distributed.initialize) first")
+    return c
+
+
+def _next_seq(gid: int, src: int, dst: int) -> int:
+    with _seq_lock:
+        k = (gid, src, dst)
+        s = _seq.get(k, 0)
+        _seq[k] = s + 1
+        return s
+
+
+def _rollback_seq(gid: int, src: int, dst: int, seq: int) -> None:
+    """Undo a failed recv's sequence claim so the channel stays in sync.
+    Only possible when no later claim happened (single outstanding recv —
+    with several in flight a timeout is fatal for the channel anyway)."""
+    with _seq_lock:
+        k = (gid, src, dst)
+        if _seq.get(k, 0) == seq + 1:
+            _seq[k] = seq
+
+
+def _timeout_ms() -> int:
+    from . import watchdog  # noqa: F401  (defines FLAGS_comm_timeout_s)
+
+    t = flags.flag_value("comm_timeout_s") or 300.0
+    return int(float(t) * 1000)
+
+
+def mp_send(arr, src: int, dst: int, gid: int = 0) -> None:
+    """Publish `arr` for (src -> dst) on group `gid`. Buffered: returns as
+    soon as the payload is in the KV store."""
+    c = _client()
+    a = np.ascontiguousarray(np.asarray(arr))
+    seq = _next_seq(gid, src, dst)
+    base = f"ptpu_p2p/{gid}/{src}-{dst}/{seq}"
+    raw = a.tobytes()
+    n_chunks = max(1, (len(raw) + _CHUNK_BYTES - 1) // _CHUNK_BYTES)
+    for i in range(n_chunks):
+        c.key_value_set_bytes(f"{base}/c{i}",
+                              raw[i * _CHUNK_BYTES:(i + 1) * _CHUNK_BYTES])
+    # meta is written LAST: its visibility implies every chunk is readable
+    c.key_value_set(f"{base}/meta", json.dumps(
+        {"dtype": np.dtype(a.dtype).name, "shape": list(a.shape),
+         "chunks": n_chunks}))
+
+
+def mp_recv(src: int, dst: int, gid: int = 0,
+            seq: int | None = None) -> np.ndarray:
+    """Block until the next (src -> dst) payload on group `gid` arrives;
+    return it as a numpy array (extension dtypes like bfloat16 preserved).
+    `seq` lets irecv claim the channel slot at post time (ordering among
+    multiple outstanding receives) and fetch later on a worker thread."""
+    from ...framework import dtype as dtype_mod
+
+    c = _client()
+    if seq is None:
+        seq = _next_seq(gid, src, dst)
+    base = f"ptpu_p2p/{gid}/{src}-{dst}/{seq}"
+    tmo = _timeout_ms()
+    try:
+        meta = json.loads(c.blocking_key_value_get(f"{base}/meta", tmo))
+    except Exception as e:
+        _rollback_seq(gid, src, dst, seq)
+        raise RuntimeError(
+            f"recv(src={src}) timed out after {tmo} ms waiting for "
+            f"{base}/meta — check the peer issued the matching send "
+            f"(p2p requires matched call order per (src,dst,group) channel)"
+        ) from e
+    try:
+        raw = b"".join(
+            c.blocking_key_value_get_bytes(f"{base}/c{i}", tmo)
+            for i in range(meta["chunks"]))
+    finally:
+        # meta was visible, so every chunk was written: always GC the keys
+        for i in range(meta["chunks"]):
+            try:
+                c.key_value_delete(f"{base}/c{i}")
+            except Exception:
+                pass
+        c.key_value_delete(f"{base}/meta")
+    dt = np.dtype(dtype_mod.to_np(meta["dtype"]))
+    return np.frombuffer(raw, dtype=dt).reshape(meta["shape"])

@@ -80,15 +80,108 @@ try:
 except NotImplementedError:
     pass
 
-# eager mailbox send/recv must refuse cross-process use
-try:
-    dist.send(t, dst=1 - rank)
-    raise SystemExit("send should have raised")
-except NotImplementedError:
-    pass
+# eager cross-process p2p: ping-pong exchange (round-3 VERDICT item 3)
+ping = paddle.Tensor(np.full((3,), float(rank * 100 + 7), np.float32))
+pong = paddle.Tensor(np.zeros((3,), np.float32))
+if rank == 0:
+    dist.send(ping, dst=1)
+    dist.recv(pong, src=1)
+else:
+    dist.recv(pong, src=0)
+    dist.send(ping, dst=0)
+np.testing.assert_allclose(np.asarray(pong._data),
+                           [float((1 - rank) * 100 + 7)] * 3)
+
+# bfloat16 payload survives the byte transport
+import jax.numpy as jnp
+bf = paddle.Tensor(jnp.asarray([1.5, -2.25, 3.0], jnp.bfloat16))
+out_bf = paddle.Tensor(jnp.zeros((3,), jnp.bfloat16))
+if rank == 0:
+    dist.send(bf, dst=1)
+    dist.recv(out_bf, src=1)
+else:
+    dist.recv(out_bf, src=0)
+    dist.send(bf, dst=0)
+assert str(out_bf._data.dtype) == "bfloat16", out_bf._data.dtype
+np.testing.assert_allclose(np.asarray(out_bf._data, np.float32),
+                           [1.5, -2.25, 3.0])
+
+# batch_isend_irecv with recv posted BEFORE send on BOTH ranks: requires
+# truly non-blocking irecv or it deadlocks (NCCL-pattern regression test)
+buf = paddle.Tensor(np.zeros((2,), np.float32))
+payload = paddle.Tensor(np.asarray([rank + 1.0, rank + 2.0], np.float32))
+tasks = dist.batch_isend_irecv([
+    dist.P2POp(dist.irecv, buf, 1 - rank),
+    dist.P2POp(dist.isend, payload, 1 - rank),
+])
+for tk in tasks:
+    tk.wait()
+np.testing.assert_allclose(np.asarray(buf._data), [2.0 - rank, 3.0 - rank])
+
+# multi-chunk payload (> one 2MB KV chunk)
+big = paddle.Tensor(np.arange(700_000, dtype=np.float32))
+out_big = paddle.Tensor(np.zeros((700_000,), np.float32))
+if rank == 0:
+    dist.send(big, dst=1)
+    dist.recv(out_big, src=1)
+else:
+    dist.recv(out_big, src=0)
+    dist.send(big, dst=0)
+np.testing.assert_allclose(np.asarray(out_big._data)[-3:],
+                           [699997.0, 699998.0, 699999.0])
 
 dist.barrier()
 print(f"WORKER_OK rank={rank}", flush=True)
+'''
+
+_PP_WORKER = '''
+"""2-process x 2-stage eager pipeline smoke: activations forward via
+dist.send/recv, activation-grads back, per-stage weight grads checked
+against the analytic value (reference pattern:
+fleet/meta_parallel/pp_utils/p2p_communication.py)."""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+
+x_np = np.linspace(-1.0, 1.0, 8, dtype=np.float32).reshape(2, 4)
+w0_np = (np.arange(12, dtype=np.float32).reshape(4, 3) - 5.0) * 0.1
+w1_np = (np.arange(6, dtype=np.float32).reshape(3, 2) + 1.0) * 0.2
+
+# analytic reference, computable on both ranks
+h_ref = x_np @ w0_np
+dy = np.ones((2, 2), np.float32)
+dh_ref = dy @ w1_np.T
+dw1_ref = h_ref.T @ dy
+dw0_ref = x_np.T @ dh_ref
+
+if rank == 0:
+    x = paddle.to_tensor(x_np)
+    w0 = paddle.to_tensor(w0_np); w0.stop_gradient = False
+    h = x @ w0
+    dist.send(h, dst=1)                       # fwd activation ->
+    gh = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    dist.recv(gh, src=1)                      # <- activation grad
+    h.backward(gh)
+    np.testing.assert_allclose(np.asarray(w0.grad._data), dw0_ref, rtol=1e-5)
+else:
+    h_in = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    dist.recv(h_in, src=0)
+    h_in.stop_gradient = False
+    w1 = paddle.to_tensor(w1_np); w1.stop_gradient = False
+    loss = (h_in @ w1).sum()
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(w1.grad._data), dw1_ref, rtol=1e-5)
+    dist.send(h_in.grad, dst=0)               # activation grad back ->
+
+dist.barrier()
+print(f"PP_OK rank={rank}", flush=True)
 '''
 
 
@@ -115,6 +208,32 @@ def test_launch_two_process_collectives(tmp_path):
     assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}\n{logs}"
     assert "WORKER_OK rank=0" in logs + r.stdout
     assert "WORKER_OK rank=1" in logs + r.stdout
+
+
+@pytest.mark.timeout(300)
+def test_launch_two_process_two_stage_pp(tmp_path):
+    """Eager cross-process pipeline: stage0 sends activations, stage1 sends
+    activation-grads back, both verify analytic weight gradients."""
+    script = tmp_path / "pp_worker.py"
+    script.write_text(_PP_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo)
+    logs = ""
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for f in logdir.iterdir():
+            logs += f.read_text()
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}\n{logs}"
+    assert "PP_OK rank=0" in logs + r.stdout
+    assert "PP_OK rank=1" in logs + r.stdout
 
 
 def test_watchdog_kills_hung_collective(tmp_path):
