@@ -1,0 +1,12 @@
+"""Audio domain library (reference: `python/paddle/audio/`).
+
+Submodules: `functional` (mel/fbank/window math), `features` (Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC layers), `backends` (wav IO over
+the stdlib `wave` module), `datasets` (audio classification datasets).
+"""
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import get_current_backend, list_available_backends, \
+    load, save, set_backend  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "datasets", "load", "save",
+           "set_backend", "get_current_backend", "list_available_backends"]

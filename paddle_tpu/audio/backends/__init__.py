@@ -1,0 +1,31 @@
+"""Audio IO backends (reference: `python/paddle/audio/backends/`).
+
+The built-in backend is `wave_backend` (stdlib `wave`, PCM wav files) — the
+same default the reference ships when paddleaudio is absent. `set_backend`
+accepts only backends reported by `list_available_backends`.
+"""
+from . import wave_backend
+from .wave_backend import AudioInfo, info, load, save  # noqa: F401
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "info", "load", "save", "AudioInfo"]
+
+_current = "wave_backend"
+
+
+def list_available_backends():
+    """Backends usable in this install (reference init_backend.py:38)."""
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"unsupported audio backend '{backend_name}'; available: "
+            f"{list_available_backends()}")
+    global _current
+    _current = backend_name

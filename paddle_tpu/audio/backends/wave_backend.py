@@ -1,0 +1,88 @@
+"""PCM wav IO over the stdlib `wave` module (reference:
+`python/paddle/audio/backends/wave_backend.py`)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+
+class AudioInfo:
+    """Metadata record (reference backend.py:25)."""
+
+    def __init__(self, sample_rate: int, num_samples: int, num_channels: int,
+                 bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath) -> AudioInfo:
+    """Read wav header metadata (reference wave_backend.py:43)."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding=f"PCM_{f.getsampwidth() * 8}")
+
+
+_NP_BY_WIDTH = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Load a PCM wav file -> (Tensor, sample_rate). `normalize=True` maps
+    samples to [-1, 1] float32 (reference wave_backend.py:95)."""
+    with wave.open(filepath, "rb") as f:
+        sr, width, nch = f.getframerate(), f.getsampwidth(), f.getnchannels()
+        if width not in _NP_BY_WIDTH:
+            raise ValueError(f"unsupported PCM sample width {width}")
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype=_NP_BY_WIDTH[width]).reshape(-1, nch)
+    if width == 1:  # unsigned 8-bit PCM is offset-binary
+        data = data.astype(np.int16) - 128
+        scale = 128.0
+    else:
+        scale = float(2 ** (width * 8 - 1))
+    if normalize:
+        out = (data.astype(np.float32) / scale)
+    else:
+        out = data
+    if channels_first:
+        out = out.T
+    return Tensor(np.ascontiguousarray(out), stop_gradient=True), sr
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """Save a waveform tensor as PCM wav (reference wave_backend.py:174)."""
+    a = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if a.ndim == 1:
+        a = a[None, :] if channels_first else a[:, None]
+    if channels_first:
+        a = a.T                                   # -> [T, C]
+    width = bits_per_sample // 8
+    if width not in _NP_BY_WIDTH:
+        raise ValueError(f"unsupported bits_per_sample {bits_per_sample}")
+    if np.issubdtype(a.dtype, np.floating):
+        scale = 128.0 if width == 1 else float(2 ** (bits_per_sample - 1))
+        q = np.clip(np.round(a * scale), -scale, scale - 1)
+        if width == 1:
+            q = q + 128
+        a = q.astype(_NP_BY_WIDTH[width])
+    else:
+        a = a.astype(_NP_BY_WIDTH[width])
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(a).tobytes())

@@ -1,0 +1,5 @@
+from .dataset import AudioClassificationDataset  # noqa: F401
+from .esc50 import ESC50  # noqa: F401
+from .tess import TESS  # noqa: F401
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]

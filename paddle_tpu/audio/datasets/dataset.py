@@ -1,0 +1,61 @@
+"""Audio classification dataset base (reference:
+`python/paddle/audio/datasets/dataset.py:29`). Items are (feature, label)
+where the feature is the raw waveform or an on-the-fly mel/mfcc feature.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+from .. import features as _features
+from ..backends import load as _load
+
+_FEAT_LAYERS = {
+    "raw": None,
+    "melspectrogram": _features.MelSpectrogram,
+    "mfcc": _features.MFCC,
+    "logmelspectrogram": _features.LogMelSpectrogram,
+    "spectrogram": _features.Spectrogram,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    def __init__(self, files, labels, feat_type: str = "raw",
+                 sample_rate=None, **kwargs):
+        super().__init__()
+        if feat_type not in _FEAT_LAYERS:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(_FEAT_LAYERS)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._feat_layer = None
+
+    def _get_data(self, input_file: str):
+        raise NotImplementedError
+
+    def _convert_to_record(self, idx):
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sr = _load(file)
+        self.sample_rate = sr
+        arr = np.asarray(waveform._data)
+        if arr.ndim == 2:
+            arr = arr[0]
+        if self.feat_type == "raw":
+            return arr, np.array(label, np.int64)
+        if self._feat_layer is None:
+            self._feat_layer = _FEAT_LAYERS[self.feat_type](
+                sr=sr, **self.feat_config)
+        from ...core.tensor import Tensor
+
+        feat = self._feat_layer(Tensor(arr[None, :]))
+        return np.asarray(feat._data)[0], np.array(label, np.int64)
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)

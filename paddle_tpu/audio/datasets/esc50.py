@@ -1,0 +1,35 @@
+"""ESC-50 environmental sound dataset (reference:
+`python/paddle/audio/datasets/esc50.py:43`). Zero-egress build: pass
+`archive_dir` pointing at an extracted ESC-50 tree (audio/ + meta/esc50.csv);
+auto-download is unavailable and raises an actionable error.
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+from .dataset import AudioClassificationDataset
+
+
+class ESC50(AudioClassificationDataset):
+    n_class = 50
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", archive_dir=None, **kwargs):
+        if archive_dir is None:
+            raise RuntimeError(
+                "ESC50 auto-download is unavailable in this build (no "
+                "network egress); download/extract ESC-50 and pass "
+                "archive_dir=<path containing audio/ and meta/esc50.csv>")
+        meta = os.path.join(archive_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta, newline="") as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = (fold != split) if mode == "train" else (fold == split)
+                if keep:
+                    files.append(os.path.join(archive_dir, "audio",
+                                              row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)

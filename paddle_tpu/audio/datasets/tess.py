@@ -1,0 +1,47 @@
+"""TESS emotional speech dataset (reference:
+`python/paddle/audio/datasets/tess.py:30`). Zero-egress build: pass
+`archive_dir` pointing at the extracted TESS tree of
+`<speaker>_<word>_<emotion>.wav` files; auto-download raises.
+"""
+from __future__ import annotations
+
+import os
+
+from .dataset import AudioClassificationDataset
+
+
+class TESS(AudioClassificationDataset):
+    n_class = 7
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", archive_dir=None, **kwargs):
+        if not (isinstance(n_folds, int) and n_folds >= 1):
+            raise ValueError(f"n_folds should be int >= 1, got {n_folds}")
+        if split not in range(1, n_folds + 1):
+            raise ValueError(f"split should be in [1, {n_folds}], got {split}")
+        if archive_dir is None:
+            raise RuntimeError(
+                "TESS auto-download is unavailable in this build (no "
+                "network egress); download/extract TESS and pass "
+                "archive_dir=<path with *_<emotion>.wav files>")
+        wavs = []
+        for root, _, names in os.walk(archive_dir):
+            wavs += [os.path.join(root, n) for n in names
+                     if n.lower().endswith(".wav")]
+        wavs.sort()
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if not keep:
+                continue
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            files.append(path)
+            labels.append(self.label_list.index(emotion))
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)

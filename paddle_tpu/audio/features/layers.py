@@ -1,0 +1,126 @@
+"""Audio feature layers (reference: `python/paddle/audio/features/layers.py`:
+Spectrogram:45, MelSpectrogram:130, LogMelSpectrogram:237, MFCC:344).
+
+Each layer precomputes its constants (window, fbank, DCT basis) as buffers
+and runs the hot math (framing + rfft + matmul) through the dispatch layer,
+so features compile into the training graph like any other op — the mel
+matmul lands on the MXU.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+from ... import signal
+from ...core.tensor import Tensor
+from ...nn import Layer
+from ..functional import compute_fbank_matrix, create_dct, get_window, \
+    power_to_db
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|STFT|^power of a batch of waveforms `(N, T)` ->
+    `(N, n_fft//2+1, num_frames)`."""
+
+    def __init__(self, n_fft: int = 512, hop_length=512, win_length=None,
+                 window: str = "hann", power: float = 1.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("Power of spectrogram must be > 0.")
+        self.power = power
+        if win_length is None:
+            win_length = n_fft
+        fft_window = get_window(window, win_length, fftbins=True, dtype=dtype)
+        self.register_buffer("fft_window", fft_window)
+        self._stft = partial(signal.stft, n_fft=n_fft, hop_length=hop_length,
+                             win_length=win_length, window=fft_window,
+                             center=center, pad_mode=pad_mode)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._stft(x)
+        return spec.abs() ** self.power
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram projected onto a mel filterbank:
+    `(N, T) -> (N, n_mels, num_frames)`."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048, hop_length=512,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            dtype=dtype)
+        self.n_mels = n_mels
+        self.f_min = f_min
+        self.f_max = f_max if f_max is not None else sr // 2
+        fbank = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=self.f_max,
+            htk=htk, norm=norm, dtype=dtype)
+        self.register_buffer("fbank_matrix", fbank)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)
+        return self.fbank_matrix @ spec
+
+
+class LogMelSpectrogram(Layer):
+    """Mel spectrogram in decibels."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            dtype=dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients:
+    `(N, T) -> (N, n_mfcc, num_frames)`."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length=None, win_length=None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk: bool = False,
+                 norm="slaney", ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, dtype: str = "float32"):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError(
+                f"n_mfcc cannot be larger than n_mels: {n_mfcc} vs {n_mels}")
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            ref_value=ref_value, amin=amin, top_db=top_db, dtype=dtype)
+        self.register_buffer("dct_matrix",
+                             create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                        dtype=dtype))
+
+    def forward(self, x: Tensor) -> Tensor:
+        log_mel = self._log_melspectrogram(x)           # [N, n_mels, F]
+        return (log_mel.transpose([0, 2, 1]) @ self.dct_matrix
+                ).transpose([0, 2, 1])                   # [N, n_mfcc, F]

@@ -1,0 +1,141 @@
+"""Audio feature math (reference: `python/paddle/audio/functional/functional.py`).
+
+Mel-scale conversions (HTK and Slaney variants), filterbank construction,
+dB conversion, and the DCT matrix. All constant-building paths are host
+numpy (they become layer buffers); `power_to_db` also accepts Tensors and
+then runs through the differentiable op layer.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct"]
+
+# Slaney mel scale: linear below 1 kHz, log above
+_F_MIN, _F_SP = 0.0, 200.0 / 3
+_MIN_LOG_HZ = 1000.0
+_MIN_LOG_MEL = (_MIN_LOG_HZ - _F_MIN) / _F_SP
+_LOGSTEP = math.log(6.4) / 27.0
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Convert Hz to mels (reference functional.py:29)."""
+    if isinstance(freq, Tensor):
+        return Tensor(np.asarray(
+            hz_to_mel(np.asarray(freq._data), htk)), stop_gradient=True)
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        out = np.where(f >= _MIN_LOG_HZ,
+                       _MIN_LOG_MEL + np.log(np.maximum(f, 1e-10)
+                                             / _MIN_LOG_HZ) / _LOGSTEP,
+                       (f - _F_MIN) / _F_SP)
+    return float(out) if np.isscalar(freq) or np.ndim(freq) == 0 else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """Convert mels to Hz (reference functional.py:83)."""
+    if isinstance(mel, Tensor):
+        return Tensor(np.asarray(
+            mel_to_hz(np.asarray(mel._data), htk)), stop_gradient=True)
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        out = np.where(m >= _MIN_LOG_MEL,
+                       _MIN_LOG_HZ * np.exp(_LOGSTEP * (m - _MIN_LOG_MEL)),
+                       _F_MIN + _F_SP * m)
+    return float(out) if np.isscalar(mel) or np.ndim(mel) == 0 else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32") -> Tensor:
+    """`n_mels` frequencies evenly spaced on the mel scale (functional.py:126)."""
+    lo, hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(np.asarray(mel_to_hz(mels, htk), dtype=dtype),
+                  stop_gradient=True)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    """Center frequencies of rfft bins (functional.py:166)."""
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype),
+                  stop_gradient=True)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype: str = "float32") -> Tensor:
+    """Triangular mel filterbank `[n_mels, n_fft//2+1]` (functional.py:189).
+    `norm='slaney'` area-normalizes each filter; a float norm applies
+    p-norm normalization per filter."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_to_hz(
+        np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                    n_mels + 2), htk))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]       # [n_mels+2, n_bins]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        norms = np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+        weights = weights / np.maximum(norms, 1e-10)
+    return Tensor(weights.astype(dtype), stop_gradient=True)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=None):
+    """Power/magnitude -> decibels with optional dynamic-range clamp
+    (functional.py:262). Differentiable when given a Tensor."""
+    if ref_value <= 0:
+        raise ValueError("ref_value must be positive")
+    if amin <= 0:
+        raise ValueError("amin must be positive")
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+    if not isinstance(spect, Tensor):
+        spect = Tensor(spect)
+
+    def impl(x, *, ref_value, amin, top_db):
+        import jax.numpy as jnp
+
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(jnp.asarray(ref_value, x.dtype), amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    if "audio_power_to_db" not in dispatch.op_registry():
+        dispatch.register_op("audio_power_to_db", impl)
+    return dispatch.apply("audio_power_to_db", [spect], {
+        "ref_value": float(ref_value), "amin": float(amin),
+        "top_db": None if top_db is None else float(top_db)})
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II basis `[n_mels, n_mfcc]` (functional.py:306)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    basis = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)   # [n_mels,n_mfcc]
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(basis.astype(dtype), stop_gradient=True)

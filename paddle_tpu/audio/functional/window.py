@@ -1,0 +1,186 @@
+"""Window functions (reference: `python/paddle/audio/functional/window.py`).
+
+All windows are host-side numpy (they become constant buffers in feature
+layers), computed with the standard closed-form definitions and returned as
+framework Tensors. `fftbins=True` gives the periodic variant (compute M+1
+symmetric points, drop the last) exactly like scipy's `sym=False`.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["get_window"]
+
+
+def _extend(M: int, sym: bool):
+    return (M, False) if sym else (M + 1, True)
+
+
+def _truncate(w, trunc):
+    return w[:-1] if trunc else w
+
+
+def _general_cosine(M: int, a, sym: bool):
+    M, trunc = _extend(M, sym)
+    fac = np.linspace(-np.pi, np.pi, M)
+    w = np.zeros(M)
+    for k, coef in enumerate(a):
+        w += coef * np.cos(k * fac)
+    return _truncate(w, trunc)
+
+
+def _general_hamming(M: int, alpha: float, sym: bool):
+    return _general_cosine(M, [alpha, 1.0 - alpha], sym)
+
+
+def _hamming(M: int, sym: bool = True):
+    return _general_hamming(M, 0.54, sym)
+
+
+def _hann(M: int, sym: bool = True):
+    return _general_hamming(M, 0.5, sym)
+
+
+def _blackman(M: int, sym: bool = True):
+    return _general_cosine(M, [0.42, 0.50, 0.08], sym)
+
+
+def _cosine(M: int, sym: bool = True):
+    M, trunc = _extend(M, sym)
+    w = np.sin(np.pi / M * (np.arange(M) + 0.5))
+    return _truncate(w, trunc)
+
+
+def _triang(M: int, sym: bool = True):
+    M, trunc = _extend(M, sym)
+    n = np.arange(1, (M + 1) // 2 + 1)
+    if M % 2 == 0:
+        w = (2 * n - 1.0) / M
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (M + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    return _truncate(w, trunc)
+
+
+def _bohman(M: int, sym: bool = True):
+    M, trunc = _extend(M, sym)
+    fac = np.abs(np.linspace(-1, 1, M)[1:-1])
+    w = (1 - fac) * np.cos(np.pi * fac) + np.sin(np.pi * fac) / np.pi
+    w = np.concatenate([[0.0], w, [0.0]])
+    return _truncate(w, trunc)
+
+
+def _gaussian(M: int, std: float, sym: bool = True):
+    M, trunc = _extend(M, sym)
+    n = np.arange(M) - (M - 1.0) / 2.0
+    w = np.exp(-(n ** 2) / (2.0 * std * std))
+    return _truncate(w, trunc)
+
+
+def _general_gaussian(M: int, p: float, sig: float, sym: bool = True):
+    M, trunc = _extend(M, sym)
+    n = np.arange(M) - (M - 1.0) / 2.0
+    w = np.exp(-0.5 * np.abs(n / sig) ** (2 * p))
+    return _truncate(w, trunc)
+
+
+def _exponential(M: int, center=None, tau: float = 1.0, sym: bool = True):
+    if sym and center is not None:
+        raise ValueError("If sym==True, center must be None.")
+    M, trunc = _extend(M, sym)
+    if center is None:
+        center = (M - 1) / 2
+    w = np.exp(-np.abs(np.arange(M) - center) / tau)
+    return _truncate(w, trunc)
+
+
+def _tukey(M: int, alpha: float = 0.5, sym: bool = True):
+    if alpha <= 0:
+        return np.ones(M)
+    if alpha >= 1.0:
+        return _hann(M, sym=sym)
+    M, trunc = _extend(M, sym)
+    n = np.arange(M)
+    width = int(alpha * (M - 1) / 2.0)
+    n1, n2, n3 = n[:width + 1], n[width + 1:M - width - 1], n[M - width - 1:]
+    w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 / alpha / (M - 1))))
+    w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1 + 2.0 * n3 / alpha
+                                    / (M - 1))))
+    w = np.concatenate([w1, np.ones(n2.shape), w3])
+    return _truncate(w, trunc)
+
+
+def _taylor(M: int, nbar: int = 4, sll: float = 30, norm: bool = True,
+            sym: bool = True):
+    """Taylor tapering window (standard SAR formulation)."""
+    M, trunc = _extend(M, sym)
+    B = 10 ** (sll / 20)
+    A = math.acosh(B) / np.pi
+    s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+    ma = np.arange(1, nbar)
+    Fm = np.zeros(nbar - 1)
+    signs = np.empty_like(ma, float)
+    signs[::2] = 1
+    signs[1::2] = -1
+    m2 = ma * ma
+    for mi, _ in enumerate(ma):
+        numer = signs[mi] * np.prod(
+            1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+        denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+            1 - m2[mi] / m2[mi + 1:])
+        Fm[mi] = numer / denom
+
+    def W(n):
+        return 1 + 2 * np.dot(
+            Fm, np.cos(2 * np.pi * ma[:, None] * (n - M / 2.0 + 0.5) / M))
+
+    w = W(np.arange(M))
+    if norm:
+        w = w / W((M - 1) / 2)
+    return _truncate(w, trunc)
+
+
+_WINDOWS = {
+    "hamming": _hamming,
+    "hann": _hann,
+    "blackman": _blackman,
+    "cosine": _cosine,
+    "triang": _triang,
+    "bohman": _bohman,
+    "gaussian": _gaussian,
+    "general_gaussian": _general_gaussian,
+    "exponential": _exponential,
+    "tukey": _tukey,
+    "taylor": _taylor,
+}
+
+_NEEDS_PARAM = ("gaussian", "general_gaussian", "exponential")
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float64") -> Tensor:
+    """Return a window tensor of a given length and type (reference
+    window.py:get_window). `('gaussian', std)`-style tuples pass extra
+    parameters; `fftbins=True` gives the periodic (DFT-even) variant."""
+    sym = not fftbins
+    args: tuple = ()
+    if isinstance(window, tuple):
+        winstr = window[0]
+        args = window[1:]
+    elif isinstance(window, str):
+        if window in _NEEDS_PARAM:
+            raise ValueError(
+                f"The '{window}' window needs one or more parameters -- "
+                "pass a tuple.")
+        winstr = window
+    else:
+        raise ValueError(f"The window type {type(window)} is not supported")
+    if winstr not in _WINDOWS:
+        raise ValueError(f"Unknown window type: {winstr}")
+    w = _WINDOWS[winstr](int(win_length), *args, sym=sym)
+    return Tensor(np.asarray(w, dtype=dtype), stop_gradient=True)

@@ -477,12 +477,30 @@ class _PendingRecv:
         return not self._thread.is_alive()
 
 
+class _DeferredMailboxRecv:
+    """Single-controller irecv handle: the mailbox pop happens at wait()
+    time, so recv-before-send batch patterns complete once the matching
+    send has been posted."""
+
+    def __init__(self, tensor, src, group):
+        self._tensor = tensor
+        self._src = src
+        self._group = group
+
+    def wait(self):
+        return recv(self._tensor, src=self._src, group=self._group)
+
+    def is_completed(self):
+        q = _mailbox.get(_group(self._group).id)
+        return bool(q)
+
+
 def irecv(tensor: Tensor, src=0, group=None, sync_op=False):
     """Non-blocking receive (NCCL irecv semantics): posts the receive and
     returns a waitable task, so recv-before-send patterns
     (batch_isend_irecv) complete instead of deadlocking."""
     if not _multiproc():
-        return recv(tensor, src=src, group=group)
+        return _DeferredMailboxRecv(tensor, src, group)
     import threading
 
     import jax

@@ -119,12 +119,13 @@ def mp_recv(src: int, dst: int, gid: int = 0,
             c.blocking_key_value_get_bytes(f"{base}/c{i}", tmo)
             for i in range(meta["chunks"]))
     finally:
-        # meta was visible, so every chunk was written: always GC the keys
-        for i in range(meta["chunks"]):
+        # meta was visible, so every chunk was written: GC best-effort —
+        # a dead service must not mask the original transport error
+        for key in [f"{base}/c{i}" for i in range(meta["chunks"])] + \
+                [f"{base}/meta"]:
             try:
-                c.key_value_delete(f"{base}/c{i}")
+                c.key_value_delete(key)
             except Exception:
                 pass
-        c.key_value_delete(f"{base}/meta")
     dt = np.dtype(dtype_mod.to_np(meta["dtype"]))
     return np.frombuffer(raw, dtype=dt).reshape(meta["shape"])

@@ -1,0 +1,19 @@
+"""Graph learning ops (reference: `python/paddle/geometric/`).
+
+TPU-split design: the compute-side message passing (`send_u_recv`,
+`send_ue_recv`, `send_uv`) and segment reductions run on-device through the
+dispatch layer (XLA scatter/segment ops — static shapes via `out_size` /
+`num_segments`); the data-prep side (`sample_neighbors`, `reindex_graph`)
+is host numpy, where dynamic result shapes belong.
+"""
+from .math import segment_max, segment_mean, segment_min, \
+    segment_sum  # noqa: F401
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+from .reindex import reindex_graph, reindex_heter_graph  # noqa: F401
+from .sampling import sample_neighbors, \
+    weighted_sample_neighbors  # noqa: F401
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]

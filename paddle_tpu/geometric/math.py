@@ -1,0 +1,69 @@
+"""Segment reductions (reference: `python/paddle/geometric/math.py`).
+On-device via `jax.ops.segment_*`; the segment count is derived from the
+ids on the host (one sync) so the compiled program has static shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _num_segments(segment_ids: Tensor) -> int:
+    ids = np.asarray(segment_ids._data)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, data, segment_ids, kind):
+    data, segment_ids = _as_tensor(data), _as_tensor(segment_ids)
+    n = _num_segments(segment_ids)
+
+    def impl(x, ids, *, n, kind):
+        import jax
+        import jax.numpy as jnp
+
+        if kind == "sum":
+            return jax.ops.segment_sum(x, ids, num_segments=n)
+        if kind == "mean":
+            s = jax.ops.segment_sum(x, ids, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1)[(...,) + (None,) * (x.ndim - 1)]
+        if kind == "max":
+            out = jax.ops.segment_max(x, ids, num_segments=n)
+        else:
+            out = jax.ops.segment_min(x, ids, num_segments=n)
+        # empty segments: paddle fills 0, jax fills +/-inf identities
+        c = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.int32), ids,
+                                num_segments=n)
+        mask = (c > 0)[(...,) + (None,) * (x.ndim - 1)]
+        return jnp.where(mask, out, jnp.zeros_like(out))
+
+    if op_name not in dispatch.op_registry():
+        dispatch.register_op(op_name, impl)
+    return dispatch.apply(op_name, [data, segment_ids],
+                          {"n": n, "kind": kind})
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Sum of rows sharing a segment id (reference math.py:segment_sum)."""
+    return _segment("geo_segment", data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("geo_segment", data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("geo_segment", data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("geo_segment", data, segment_ids, "min")

@@ -1,0 +1,107 @@
+"""Message passing (reference:
+`python/paddle/geometric/message_passing/send_recv.py:55,210,413`).
+
+gather(src) -> combine(message_op) -> scatter-reduce(dst) fused into one
+compiled XLA program per (shapes, ops) signature. `out_size` pins the
+output's leading dim; otherwise it defaults to `x.shape[0]` (reference
+behavior), keeping shapes static under jit.
+"""
+from __future__ import annotations
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]
+
+_REDUCES = ("sum", "mean", "max", "min")
+_MESSAGES = ("add", "sub", "mul", "div")
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _scatter_reduce(msg, dst, n, reduce_op):
+    import jax
+    import jax.numpy as jnp
+
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msg, dst, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)[(...,) + (None,) * (msg.ndim - 1)]
+    out = (jax.ops.segment_max if reduce_op == "max"
+           else jax.ops.segment_min)(msg, dst, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32), dst,
+                            num_segments=n)
+    mask = (c > 0)[(...,) + (None,) * (msg.ndim - 1)]
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+def _combine(a, b, message_op):
+    if message_op == "add":
+        return a + b
+    if message_op == "sub":
+        return a - b
+    if message_op == "mul":
+        return a * b
+    return a / b
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """out[d] = reduce over edges e with dst[e]==d of x[src[e]]."""
+    if reduce_op not in _REDUCES:
+        raise ValueError(f"reduce_op must be one of {_REDUCES}")
+    x, src_index, dst_index = map(_as_tensor, (x, src_index, dst_index))
+    n = int(out_size) if out_size is not None else int(x._data.shape[0])
+
+    def impl(x, src, dst, *, n, reduce_op):
+        msg = x[src]
+        return _scatter_reduce(msg, dst, n, reduce_op)
+
+    if "geo_send_u_recv" not in dispatch.op_registry():
+        dispatch.register_op("geo_send_u_recv", impl)
+    return dispatch.apply("geo_send_u_recv", [x, src_index, dst_index],
+                          {"n": n, "reduce_op": str(reduce_op)})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """out[d] = reduce of message_op(x[src[e]], y[e]) over edges into d."""
+    if message_op not in _MESSAGES:
+        raise ValueError(f"message_op must be one of {_MESSAGES}")
+    if reduce_op not in _REDUCES:
+        raise ValueError(f"reduce_op must be one of {_REDUCES}")
+    x, y, src_index, dst_index = map(_as_tensor,
+                                     (x, y, src_index, dst_index))
+    n = int(out_size) if out_size is not None else int(x._data.shape[0])
+
+    def impl(x, y, src, dst, *, n, message_op, reduce_op):
+        msg = _combine(x[src], y, message_op)
+        return _scatter_reduce(msg, dst, n, reduce_op)
+
+    if "geo_send_ue_recv" not in dispatch.op_registry():
+        dispatch.register_op("geo_send_ue_recv", impl)
+    return dispatch.apply("geo_send_ue_recv",
+                          [x, y, src_index, dst_index],
+                          {"n": n, "message_op": str(message_op),
+                           "reduce_op": str(reduce_op)})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages: out[e] = message_op(x[src[e]], y[dst[e]])."""
+    if message_op not in _MESSAGES:
+        raise ValueError(f"message_op must be one of {_MESSAGES}")
+    x, y, src_index, dst_index = map(_as_tensor,
+                                     (x, y, src_index, dst_index))
+
+    def impl(x, y, src, dst, *, message_op):
+        return _combine(x[src], y[dst], message_op)
+
+    if "geo_send_uv" not in dispatch.op_registry():
+        dispatch.register_op("geo_send_uv", impl)
+    return dispatch.apply("geo_send_uv", [x, y, src_index, dst_index],
+                          {"message_op": str(message_op)})

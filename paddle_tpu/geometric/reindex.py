@@ -1,0 +1,57 @@
+"""Graph reindexing (reference: `python/paddle/geometric/reindex.py:32`).
+Host-side numpy: result shapes are data-dependent (unique-node count), so
+this belongs on the host like the reference's CPU path; the reindexed ids
+then feed static-shape device programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["reindex_graph", "reindex_heter_graph"]
+
+
+def _np(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t)
+
+
+def _reindex(x, neighbor_lists, count_lists):
+    x = _np(x).astype(np.int64)
+    seen = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(x)
+    reindex_srcs, reindex_dsts = [], []
+    for neighbors, counts in zip(neighbor_lists, count_lists):
+        nb = _np(neighbors).astype(np.int64)
+        ct = _np(counts).astype(np.int64)
+        src = np.empty(len(nb), np.int64)
+        for i, v in enumerate(nb):
+            v = int(v)
+            if v not in seen:
+                seen[v] = len(out_nodes)
+                out_nodes.append(v)
+            src[i] = seen[v]
+        dst = np.repeat(np.arange(len(ct), dtype=np.int64), ct)
+        reindex_srcs.append(src)
+        reindex_dsts.append(dst)
+    return out_nodes, reindex_srcs, reindex_dsts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex node ids to a dense [0, n) range; returns
+    (reindex_src, reindex_dst, out_nodes)."""
+    out_nodes, srcs, dsts = _reindex(x, [neighbors], [count])
+    return (Tensor(srcs[0], stop_gradient=True),
+            Tensor(dsts[0], stop_gradient=True),
+            Tensor(np.asarray(out_nodes, np.int64), stop_gradient=True))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: `neighbors`/`count` are per-edge-type lists
+    sharing one node id space."""
+    out_nodes, srcs, dsts = _reindex(x, neighbors, count)
+    return ([Tensor(s, stop_gradient=True) for s in srcs],
+            [Tensor(d, stop_gradient=True) for d in dsts],
+            Tensor(np.asarray(out_nodes, np.int64), stop_gradient=True))

@@ -1,0 +1,80 @@
+"""Neighbor sampling (reference:
+`python/paddle/geometric/sampling/neighbors.py:30`). Host-side numpy over a
+CSC graph (`row`, `colptr`): sampling output sizes are data-dependent, so
+it runs on the host like the reference's CPU kernel; device compute starts
+after `reindex_graph`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+__all__ = ["sample_neighbors", "weighted_sample_neighbors"]
+
+_host_rng = None
+
+
+def _rng():
+    """Host sampler seeded once from the framework generator (so
+    paddle.seed reproduces sampling), advancing across calls."""
+    global _host_rng
+    if _host_rng is None:
+        _host_rng = np.random.default_rng(
+            _random._default_generator.initial_seed())
+    return _host_rng
+
+
+def _np(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t)
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weights=None):
+    row = _np(row).astype(np.int64)
+    colptr = _np(colptr).astype(np.int64)
+    nodes = _np(input_nodes).astype(np.int64)
+    eid_arr = None if eids is None else _np(eids).astype(np.int64)
+    w_arr = None if weights is None else _np(weights).astype(np.float64)
+    rng = _rng()
+
+    out_n, out_count, out_eids = [], [], []
+    for u in nodes:
+        lo, hi = int(colptr[u]), int(colptr[u + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        elif w_arr is not None:
+            p = w_arr[lo:hi]
+            p = p / p.sum() if p.sum() > 0 else None
+            idx = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+        else:
+            idx = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row[idx])
+        out_count.append(len(idx))
+        if return_eids:
+            out_eids.append(idx if eid_arr is None else eid_arr[idx])
+    neighbors = np.concatenate(out_n) if out_n else np.empty(0, np.int64)
+    counts = np.asarray(out_count, np.int64)
+    res = (Tensor(neighbors, stop_gradient=True),
+           Tensor(counts, stop_gradient=True))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.empty(0, np.int64)
+        return res + (Tensor(e, stop_gradient=True),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to `sample_size` in-neighbors per input node;
+    returns (neighbors, counts[, eids])."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling variant (reference neighbors.py)."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+                   weights=edge_weight)

@@ -1,0 +1,75 @@
+"""Model hub (reference: `python/paddle/hub.py` -> `hapi/hub.py`).
+
+Entrypoints are functions defined in a repo's `hubconf.py`. This build
+fully supports `source='local'` (import hubconf from a directory); remote
+github/gitee sources need network egress and raise an actionable error.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _resolve(repo_dir: str, source: str):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"Unknown source: {source}; should be 'github', 'gitee' or "
+            "'local'")
+    if source != "local":
+        raise RuntimeError(
+            f"hub source '{source}' needs network egress, which this build "
+            "does not have; clone the repo yourself and use source='local'")
+    return _load_hubconf(repo_dir)
+
+
+def _check_dependencies(m):
+    deps = getattr(m, "dependencies", None)
+    if deps:
+        missing = [d for d in deps if importlib.util.find_spec(d) is None]
+        if missing:
+            raise RuntimeError(f"Missing dependencies: {missing}")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entry point names exposed by the repo's hubconf (reference
+    hapi/hub.py:list)."""
+    m = _resolve(repo_dir, source)
+    return [name for name in dir(m)
+            if callable(getattr(m, name)) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """Docstring of one entry point (reference hapi/hub.py:help)."""
+    m = _resolve(repo_dir, source)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entry point (reference hapi/hub.py:load)."""
+    m = _resolve(repo_dir, source)
+    _check_dependencies(m)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn(**kwargs)

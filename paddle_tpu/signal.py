@@ -1,0 +1,220 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Reference surface: `python/paddle/signal.py` (frame:42, overlap_add:167,
+stft:272, istft:449). TPU-first implementation: framing is a static-shape
+gather (XLA has no strided views), the DFT rides `jnp.fft` (XLA FFT custom
+call), and everything is registered through the eager dispatch layer so the
+ops are differentiable and traceable by `to_static` like any other op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import dispatch
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _reg(name, fn, multi_out=False):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn, multi_out=multi_out)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames of length `frame_length` every `hop_length`
+    samples along the last (axis=-1, frames appended after) or first
+    (axis=0, frames prepended) dimension (reference signal.py:42)."""
+    x = _as_tensor(x)
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError(
+            f"frame_length ({frame_length}) and hop_length ({hop_length}) "
+            "must be positive")
+    seq_axis = -1 if axis in (-1, x._data.ndim - 1) else 0
+    n = x._data.shape[seq_axis]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds signal length ({n})")
+
+    def impl(x, *, frame_length, hop_length, last):
+        import jax.numpy as jnp
+
+        n = x.shape[-1 if last else 0]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])      # [F, L]
+        if last:
+            out = jnp.take(x, idx.reshape(-1), axis=-1)
+            out = out.reshape(x.shape[:-1] + (n_frames, frame_length))
+            return jnp.swapaxes(out, -1, -2)             # [..., L, F]
+        out = jnp.take(x, idx.reshape(-1), axis=0)
+        out = out.reshape((n_frames, frame_length) + x.shape[1:])
+        return jnp.swapaxes(out, 0, 1)                   # [L, F, ...]
+
+    _reg("signal_frame", impl)
+    return dispatch.apply("signal_frame", [x], {
+        "frame_length": int(frame_length), "hop_length": int(hop_length),
+        "last": seq_axis == -1})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from frames by summing at `hop_length` offsets
+    (reference signal.py:167). Inverse of `frame` when windows sum to one."""
+    x = _as_tensor(x)
+    if hop_length <= 0:
+        raise ValueError(f"hop_length ({hop_length}) must be positive")
+    last = axis in (-1, x._data.ndim - 1)
+
+    def impl(x, *, hop_length, last):
+        import jax.numpy as jnp
+
+        if not last:                      # [L, F, ...] -> [..., L, F]
+            x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -1)
+        L, F = x.shape[-2], x.shape[-1]
+        out_len = (F - 1) * hop_length + L
+        seg = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+        idx = (jnp.arange(F)[:, None] * hop_length
+               + jnp.arange(L)[None, :]).reshape(-1)     # [F*L]
+        frames = jnp.swapaxes(x, -1, -2).reshape(x.shape[:-2] + (F * L,))
+        seg = seg.at[..., idx].add(frames)
+        if not last:                      # back to [out_len, ...]
+            seg = jnp.moveaxis(seg, -1, 0)
+        return seg
+
+    _reg("signal_overlap_add", impl)
+    return dispatch.apply("signal_overlap_add", [x],
+                          {"hop_length": int(hop_length), "last": bool(last)})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py:272). Returns a
+    complex tensor `[..., n_fft//2+1, num_frames]` (onesided) or
+    `[..., n_fft, num_frames]`."""
+    x = _as_tensor(x)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    if hop_length <= 0:
+        raise ValueError(f"hop_length ({hop_length}) must be positive")
+    if not win_length <= n_fft:
+        raise ValueError(f"win_length ({win_length}) must be <= n_fft ({n_fft})")
+    is_complex_in = np.dtype(x._data.dtype).kind == "c"
+    if is_complex_in and onesided:
+        raise ValueError("onesided must be False for complex input")
+
+    if window is not None:
+        w = _as_tensor(window)
+        if tuple(w._data.shape) != (win_length,):
+            raise ValueError(
+                f"window must be a 1-D tensor of size win_length "
+                f"({win_length}), got {tuple(w._data.shape)}")
+    else:
+        w = Tensor(np.ones((win_length,), np.float32), stop_gradient=True)
+
+    def impl(x, w, *, n_fft, hop_length, center, pad_mode, normalized,
+             onesided):
+        import jax.numpy as jnp
+
+        win_length = w.shape[0]
+        if win_length < n_fft:            # center-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+            x = jnp.pad(x, cfg, mode=pad_mode)
+        n = x.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])              # [F, N]
+        frames = jnp.take(x, idx.reshape(-1), axis=-1).reshape(
+            x.shape[:-1] + (n_frames, n_fft))
+        frames = frames * w
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)                 # [..., freq, F]
+
+    _reg("signal_stft", impl)
+    return dispatch.apply("signal_stft", [x, w], {
+        "n_fft": int(n_fft), "hop_length": hop_length, "center": bool(center),
+        "pad_mode": str(pad_mode), "normalized": bool(normalized),
+        "onesided": bool(onesided)})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:449): least-squares reconstruction `sum(w*frame)/sum(w^2)`."""
+    x = _as_tensor(x)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    if np.dtype(x._data.dtype).kind != "c":
+        raise ValueError("istft expects a complex STFT tensor")
+    n_freq = x._data.shape[-2]
+    expect = n_fft // 2 + 1 if onesided else n_fft
+    if n_freq != expect:
+        raise ValueError(
+            f"input freq dim ({n_freq}) does not match n_fft ({n_fft}) with "
+            f"onesided={onesided} (expected {expect})")
+
+    if window is not None:
+        w = _as_tensor(window)
+        if tuple(w._data.shape) != (win_length,):
+            raise ValueError(
+                f"window must be a 1-D tensor of size win_length "
+                f"({win_length}), got {tuple(w._data.shape)}")
+    else:
+        w = Tensor(np.ones((win_length,), np.float32), stop_gradient=True)
+
+    def impl(x, w, *, n_fft, hop_length, center, normalized, onesided,
+             length, return_complex):
+        import jax.numpy as jnp
+
+        win_length = w.shape[0]
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(x, -1, -2)                    # [..., F, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        wf = w.astype(frames.real.dtype) if frames.dtype.kind == "c" else \
+            w.astype(frames.dtype)
+        frames = frames * wf
+        F = frames.shape[-2]
+        out_len = (F - 1) * hop_length + n_fft
+        idx = (jnp.arange(F)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        sig = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        sig = sig.at[..., idx].add(
+            frames.reshape(frames.shape[:-2] + (F * n_fft,)))
+        env = jnp.zeros((out_len,), wf.dtype).at[idx].add(
+            jnp.broadcast_to(wf * wf, (F, n_fft)).reshape(-1))
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    _reg("signal_istft", impl)
+    return dispatch.apply("signal_istft", [x, w], {
+        "n_fft": int(n_fft), "hop_length": hop_length, "center": bool(center),
+        "normalized": bool(normalized), "onesided": bool(onesided),
+        "length": None if length is None else int(length),
+        "return_complex": bool(return_complex)})

@@ -1,0 +1,19 @@
+"""Build configuration paths (reference: `python/paddle/sysconfig.py`)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory for C header files of the framework (reference
+    sysconfig.py:get_include)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib() -> str:
+    """Directory for the framework's native libraries (reference
+    sysconfig.py:get_lib)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "libs")

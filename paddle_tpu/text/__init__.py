@@ -1,0 +1,7 @@
+"""Text domain library (reference: `python/paddle/text/__init__.py`)."""
+from .datasets import WMT14, WMT16, Conll05st, Imdb, Imikolov, Movielens, \
+    UCIHousing  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]

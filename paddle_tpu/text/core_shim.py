@@ -1,0 +1,5 @@
+"""Local import indirection so text/ has no import cycle with the root
+package (nn imports during paddle_tpu/__init__ would recurse)."""
+from ..core import dispatch  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..nn import Layer  # noqa: F401

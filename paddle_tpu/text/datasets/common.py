@@ -1,0 +1,12 @@
+"""Shared helper for the zero-egress build: every text dataset takes the
+archive the reference would download via `data_file=`; absent files raise an
+actionable error instead of attempting a download."""
+
+
+def require_data_file(data_file, name: str, url_hint: str):
+    if data_file is None:
+        raise RuntimeError(
+            f"{name}: auto-download is unavailable in this build (no "
+            f"network egress). Download {url_hint} yourself and pass "
+            f"data_file=<path>.")
+    return data_file

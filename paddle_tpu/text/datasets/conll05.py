@@ -1,0 +1,140 @@
+"""CoNLL-2005 SRL test dataset (reference:
+`python/paddle/text/datasets/conll05.py`). Parses the propbank-style
+words/props gz pair inside the release tarball into
+(sentence, predicate, BIO labels) triples; items are the 9-array SRL
+feature tuple (word ids, five context windows, predicate id, mark, labels).
+"""
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download: bool = True):
+        self.data_file = require_data_file(
+            data_file, "Conll05st", "the conll05st-release tarball")
+        self.word_dict_file = require_data_file(
+            word_dict_file, "Conll05st", "the word dict file")
+        self.verb_dict_file = require_data_file(
+            verb_dict_file, "Conll05st", "the verb dict file")
+        self.target_dict_file = require_data_file(
+            target_dict_file, "Conll05st", "the target dict file")
+        self.emb_file = emb_file
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    def _load_dict(self, filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    def _load_label_dict(self, filename):
+        tags = []
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")) and line[2:] not in tags:
+                    tags.append(line[2:])
+        d = {}
+        for i, tag in enumerate(tags):
+            d[f"B-{tag}"] = 2 * i
+            d[f"I-{tag}"] = 2 * i + 1
+        d["O"] = 2 * len(tags)
+        return d
+
+    def _parse_props(self, cols):
+        """One predicate column of prop brackets -> BIO label sequence."""
+        cur, inside, out = "O", False, []
+        for tok in cols:
+            if tok == "*":
+                out.append(f"I-{cur}" if inside else "O")
+            elif tok == "*)":
+                out.append(f"I-{cur}")
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append(f"B-{cur}")
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append(f"B-{cur}")
+                inside = True
+            else:
+                raise RuntimeError(f"Unexpected label: {tok}")
+        return out
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, seg = [], []
+                for wline, pline in zip(words, props):
+                    word = wline.strip().decode()
+                    cols = pline.strip().decode().split()
+                    if not cols:          # sentence boundary
+                        if seg:
+                            by_col = [[row[i] for row in seg]
+                                      for i in range(len(seg[0]))]
+                            verbs = [v for v in by_col[0] if v != "-"]
+                            for i, col in enumerate(by_col[1:]):
+                                self.sentences.append(sent)
+                                self.predicates.append(verbs[i])
+                                self.labels.append(self._parse_props(col))
+                        sent, seg = [], []
+                    else:
+                        sent.append(word)
+                        seg.append(cols)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, fallback in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                    (0, "0", None), (1, "p1", "eos"),
+                                    (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fallback
+        word_idx = [self.word_dict.get(w, UNK_IDX) for w in sentence]
+        rows = [word_idx]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            rows.append([self.word_dict.get(ctx[name], UNK_IDX)] * n)
+        rows.append([self.predicate_dict.get(predicate)] * n)
+        rows.append(mark)
+        rows.append([self.label_dict.get(w) for w in labels])
+        return tuple(np.array(r) for r in rows)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        """(word_dict, verb_dict, label_dict) triple (reference API)."""
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if self.emb_file is None:
+            raise RuntimeError("pass emb_file= to use get_embedding")
+        return self.emb_file

@@ -1,0 +1,67 @@
+"""IMDB sentiment dataset (reference: `python/paddle/text/datasets/imdb.py`).
+Parses the aclImdb tarball: docs are lowercase-tokenized word-id lists,
+labels 0 (pos) / 1 (neg); the dictionary keeps words with freq > cutoff,
+sorted by (-freq, word), with <unk> appended last.
+"""
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode: str = "train", cutoff: int = 150,
+                 download: bool = True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = require_data_file(
+            data_file, "Imdb", "the aclImdb_v1 tarball")
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        trans = str.maketrans("", "", string.punctuation)
+        with tarfile.open(self.data_file) as tf:
+            member = tf.next()
+            while member is not None:
+                if pattern.match(member.name):
+                    data = tf.extractfile(member).read().decode("utf-8",
+                                                                "ignore")
+                    docs.append(data.translate(trans).lower().split())
+                member = tf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        freq = {}
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        UNK = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, tag in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{self.mode}/{tag}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, UNK) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)

@@ -1,0 +1,81 @@
+"""imikolov (Penn Treebank) language-model dataset (reference:
+`python/paddle/text/datasets/imikolov.py`). N-gram or seq-to-seq samples
+over a frequency-sorted word dictionary built from the PTB tarball.
+"""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+
+class Imikolov(Dataset):
+    def __init__(self, data_file=None, data_type: str = "NGRAM",
+                 window_size: int = -1, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = True):
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(
+                f"data_type should be 'NGRAM' or 'SEQ', got {data_type}")
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode.lower()
+        self.min_word_freq = min_word_freq
+        self.data_file = require_data_file(
+            data_file, "Imikolov", "the PTB simple-examples tarball")
+        self.word_idx = self._build_dict()
+        self.data = []
+        self._load_data()
+
+    def _word_count(self, f, counts=None):
+        counts = counts if counts is not None else {}
+        for line in f:
+            for w in ["<s>", *line.decode().strip().split(), "<e>"]:
+                counts[w] = counts.get(w, 0) + 1
+        return counts
+
+    def _build_dict(self):
+        with tarfile.open(self.data_file) as tf:
+            trainf = tf.extractfile("./simple-examples/data/ptb.train.txt")
+            testf = tf.extractfile("./simple-examples/data/ptb.valid.txt")
+            freq = self._word_count(testf, self._word_count(trainf))
+        freq.pop("<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] >= self.min_word_freq]
+        kept = sorted(kept, key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_data(self):
+        suffix = {"train": "train", "test": "valid"}[self.mode]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(f"./simple-examples/data/ptb.{suffix}.txt")
+            UNK = self.word_idx["<unk>"]
+            for line in f:
+                if self.data_type == "NGRAM":
+                    if self.window_size <= 0:
+                        raise ValueError("Invalid gram length")
+                    toks = ["<s>", *line.decode().strip().split(), "<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, UNK) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    toks = line.decode().strip().split()
+                    ids = [self.word_idx.get(w, UNK) for w in toks]
+                    src = [self.word_idx["<s>"], *ids]
+                    trg = [*ids, self.word_idx["<e>"]]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

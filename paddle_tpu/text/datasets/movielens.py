@@ -1,0 +1,110 @@
+"""Movielens ml-1m dataset (reference:
+`python/paddle/text/datasets/movielens.py`). Items are
+(user_id, gender, age-bucket, job, movie_id, category-ids, title-ids,
+rating) arrays parsed from the ml-1m zip's users/movies/ratings .dat files.
+"""
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 download: bool = True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = require_data_file(
+            data_file, "Movielens", "the ml-1m zip archive")
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _namelist(self, zf, suffix):
+        for name in zf.namelist():
+            if name.endswith(suffix):
+                return name
+        raise RuntimeError(f"{suffix} not found in {self.data_file}")
+
+    def _load_meta_info(self):
+        self.movie_info, self.user_info = {}, {}
+        categories, titles = set(), set()
+        pattern = re.compile(r"^(.*)\((\d{4})\)$")
+        with zipfile.ZipFile(self.data_file) as zf:
+            with zf.open(self._namelist(zf, "movies.dat")) as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1").strip() \
+                        .split("::")
+                    m = pattern.match(title)
+                    title = m.group(1).strip() if m else title
+                    cat_list = cats.split("|")
+                    categories.update(cat_list)
+                    titles.update(w.lower() for w in title.split())
+                    self.movie_info[int(mid)] = MovieInfo(mid, cat_list,
+                                                          title)
+            with zf.open(self._namelist(zf, "users.dat")) as f:
+                for line in f:
+                    uid, gender, age, job, _zip = line.decode("latin1") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+        self.categories_dict = {c: i for i, c in enumerate(sorted(categories))}
+        self.movie_title_dict = {t: i for i, t in enumerate(sorted(titles))}
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as zf:
+            with zf.open(self._namelist(zf, "ratings.dat")) as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin1").strip() \
+                        .split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating)]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

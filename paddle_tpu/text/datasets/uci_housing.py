@@ -1,0 +1,43 @@
+"""UCI housing regression dataset (reference:
+`python/paddle/text/datasets/uci_housing.py`). Space-separated 14-column
+records; features mean-centered and range-scaled; 80/20 train/test split.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+FEATURE_NAMES = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode: str = "train",
+                 download: bool = True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = require_data_file(
+            data_file, "UCIHousing", "the UCI housing.data file")
+        self.dtype = "float32"
+        self._load_data()
+
+    def _load_data(self, feature_num: int = 14, ratio: float = 0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(-1, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1]).astype(self.dtype),
+                np.array(row[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)

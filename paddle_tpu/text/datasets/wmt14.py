@@ -1,0 +1,79 @@
+"""WMT14 en-fr translation dataset (reference:
+`python/paddle/text/datasets/wmt14.py`). The tarball carries pre-built
+src/trg .dict files and tab-separated bitext; items are
+(src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk> at ids 0/1/2.
+"""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+START, END, UNK = "<s>", "<e>", "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode: str = "train",
+                 dict_size: int = -1, download: bool = True):
+        if mode.lower() not in ("train", "test", "gen"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'gen', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = require_data_file(
+            data_file, "WMT14", "the wmt14 bitext tarball")
+        if dict_size <= 0:
+            raise ValueError("dict_size should be set as positive number")
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _to_dict(self, fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.strip().decode()] = i
+        return out
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            names = [m.name for m in f.getmembers()]
+            src_dicts = [n for n in names if n.endswith("src.dict")]
+            trg_dicts = [n for n in names if n.endswith("trg.dict")]
+            if not src_dicts or not trg_dicts:
+                raise RuntimeError(
+                    f"{self.data_file} missing src.dict/trg.dict members")
+            self.src_dict = self._to_dict(f.extractfile(src_dicts[0]),
+                                          self.dict_size)
+            self.trg_dict = self._to_dict(f.extractfile(trg_dicts[0]),
+                                          self.dict_size)
+            data_names = [n for n in names
+                          if f"{self.mode}/" in n and not n.endswith("dict")
+                          and f.getmember(n).isfile()]
+            for name in data_names:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [self.src_dict.get(w, UNK_IDX)
+                               for w in [START, *src_words, END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append([self.trg_dict[START], *trg_ids])
+                    self.trg_ids_next.append([*trg_ids, self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -1,0 +1,80 @@
+"""WMT16 en-de dataset (reference:
+`python/paddle/text/datasets/wmt16.py`). Dictionaries are built in memory
+from the tarball's `wmt16/train` bitext (top-frequency words after the
+<s>/<e>/<unk> specials) — the reference caches them to DATA_HOME, this
+build keeps them in memory (zero implicit filesystem writes).
+"""
+from __future__ import annotations
+
+import tarfile
+from collections import defaultdict
+
+import numpy as np
+
+from ...io import Dataset
+from .common import require_data_file
+
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+
+class WMT16(Dataset):
+    def __init__(self, data_file=None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", download: bool = True):
+        if mode.lower() not in ("train", "test", "val"):
+            raise ValueError(
+                f"mode should be 'train', 'test' or 'val', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = require_data_file(
+            data_file, "WMT16", "the wmt16 tarball")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict_size should be set as positive number")
+        self.lang = lang
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(src_dict_size, lang)
+        self.trg_dict = self._build_dict(trg_dict_size,
+                                         "de" if lang == "en" else "en")
+        self._load_data()
+
+    def _build_dict(self, dict_size, lang):
+        freq = defaultdict(int)
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                sen = parts[0] if lang == self.lang else parts[1]
+                for w in sen.split():
+                    freq[w] += 1
+        words = [START_MARK, END_MARK, UNK_MARK] + [
+            w for w, _ in sorted(freq.items(), key=lambda kv: -kv[1])]
+        return {w: i for i, w in enumerate(words[:dict_size])}
+
+    def _load_data(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        unk_src = self.src_dict[UNK_MARK]
+        unk_trg = self.trg_dict[UNK_MARK]
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_seq = parts[0] if self.lang == "en" else parts[1]
+                trg_seq = parts[1] if self.lang == "en" else parts[0]
+                src_ids = [self.src_dict[START_MARK]] + [
+                    self.src_dict.get(w, unk_src) for w in src_seq.split()
+                ] + [self.src_dict[END_MARK]]
+                trg_words = trg_seq.split()
+                trg_ids = [self.trg_dict.get(w, unk_trg) for w in trg_words]
+                self.src_ids.append(src_ids)
+                self.trg_ids.append([self.trg_dict[START_MARK], *trg_ids])
+                self.trg_ids_next.append([*trg_ids,
+                                          self.trg_dict[END_MARK]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -1,0 +1,85 @@
+"""Viterbi decoding for CRF-style sequence tagging (reference:
+`python/paddle/text/viterbi_decode.py`).
+
+TPU-first: the DP recursion over time steps is a `lax.scan` (static trip
+count, no Python loop under jit), the per-step max/argmax vectorizes over
+the tag dimension, and the backtrace is a second scan over stored argmax
+pointers — one compiled program for any batch of sequences.
+"""
+from __future__ import annotations
+
+from .core_shim import Layer, Tensor, dispatch
+
+
+def _impl(potentials, lengths, transitions, *, include_bos_eos_tag):
+    import jax
+    import jax.numpy as jnp
+
+    B, T, N = potentials.shape
+    trans = transitions
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 is BOS, N-1 is EOS; first step starts
+        # from BOS, the last step transitions to EOS.
+        alpha0 = potentials[:, 0] + trans[N - 2][None, :]
+    else:
+        alpha0 = potentials[:, 0]
+
+    def step(carry, t):
+        alpha, _ = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)                  # [B, N]
+        best_score = jnp.max(scores, axis=1) + potentials[:, t]
+        # sequences shorter than t keep their alpha frozen
+        live = (t < lengths)[:, None]
+        alpha = jnp.where(live, best_score, alpha)
+        return (alpha, t), (best_prev, live)
+
+    (alpha, _), (ptrs, lives) = jax.lax.scan(
+        step, (alpha0, jnp.asarray(0)), jnp.arange(1, T))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, N - 1][None, :]
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1)                        # [B]
+
+    def back(carry, xs):
+        tag = carry
+        ptr, live = xs
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(live[:, 0], prev, tag)
+        return tag, tag
+
+    _, path_rev = jax.lax.scan(back, last_tag, (ptrs, lives), reverse=True)
+    path = jnp.concatenate([path_rev, last_tag[None, :]], axis=0)  # [T, B]
+    return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Decode the highest-scoring tag paths.
+
+    Args: potentials `[B, T, N]` unary scores, transition_params `[N, N]`,
+    lengths `[B]` valid steps per sequence. Returns (scores `[B]`,
+    paths `[B, T]`).
+    """
+    pot = potentials if isinstance(potentials, Tensor) else Tensor(potentials)
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(transition_params)
+    lens = lengths if isinstance(lengths, Tensor) else Tensor(lengths)
+    if "viterbi_decode" not in dispatch.op_registry():
+        dispatch.register_op("viterbi_decode", _impl, multi_out=True)
+    return dispatch.apply("viterbi_decode", [pot, lens, trans],
+                          {"include_bos_eos_tag": bool(include_bos_eos_tag)})
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper over `viterbi_decode` holding the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

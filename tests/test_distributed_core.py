@@ -226,6 +226,18 @@ def test_p2p_shift_and_mailbox():
     dist.recv(dst, src=0)
     np.testing.assert_array_equal(np.asarray(dst._data),
                                   np.asarray(src._data))
+    # recv posted BEFORE send via batch_isend_irecv: the deferred handle
+    # pops the mailbox at wait() time instead of raising
+    buf = paddle.Tensor(np.zeros(4, np.float32))
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.irecv, buf, 0),
+        dist.P2POp(dist.isend, src, 0),
+    ])
+    assert tasks[0].is_completed()  # send has been posted by now
+    for tk in tasks:
+        tk.wait()
+    np.testing.assert_array_equal(np.asarray(buf._data),
+                                  np.asarray(src._data))
 
 
 def test_groups_and_env():
