@@ -8,8 +8,6 @@ matmul lands on the MXU.
 """
 from __future__ import annotations
 
-from functools import partial
-
 from ... import signal
 from ...core.tensor import Tensor
 from ...nn import Layer
@@ -35,12 +33,13 @@ class Spectrogram(Layer):
             win_length = n_fft
         fft_window = get_window(window, win_length, fftbins=True, dtype=dtype)
         self.register_buffer("fft_window", fft_window)
-        self._stft = partial(signal.stft, n_fft=n_fft, hop_length=hop_length,
-                             win_length=win_length, window=fft_window,
-                             center=center, pad_mode=pad_mode)
+        self._stft_cfg = dict(n_fft=n_fft, hop_length=hop_length,
+                              win_length=win_length, center=center,
+                              pad_mode=pad_mode)
 
     def forward(self, x: Tensor) -> Tensor:
-        spec = self._stft(x)
+        # read the buffer at call time so set_state_dict/casts take effect
+        spec = signal.stft(x, window=self.fft_window, **self._stft_cfg)
         return spec.abs() ** self.power
 
 

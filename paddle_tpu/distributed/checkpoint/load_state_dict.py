@@ -11,32 +11,53 @@ only its slice.
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
 from typing import Dict
 
 import numpy as np
 
 from ...core.tensor import Tensor
-from .metadata import Metadata
-from .save_state_dict import _wait_pending
+from ...framework import safetensors as sft
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import _wait_pending, shard_name
 
 __all__ = ["load_state_dict"]
 
 
 class _StorageReader:
-    """Lazily loads per-device .distcp shard files, caching by file."""
+    """Lazy per-shard reads from the safetensors .distcp files: only the
+    header is parsed up front; each tensor read seeks its offsets and
+    verifies its crc32 (`framework/safetensors.py`)."""
 
     def __init__(self, path: str):
         self.path = path
-        self._cache: Dict[str, dict] = {}
+        self._readers: Dict[str, sft.SafetensorsReader] = {}
 
     def blob(self, fname: str, key, offset):
-        blobs = self._cache.get(fname)
-        if blobs is None:
-            with open(os.path.join(self.path, fname), "rb") as f:
-                blobs = self._cache[fname] = pickle.load(f)
-        return blobs[(key, tuple(offset))]
+        r = self._readers.get(fname)
+        if r is None:
+            r = self._readers[fname] = sft.SafetensorsReader(
+                os.path.join(self.path, fname))
+        return r.get_tensor(shard_name(key, offset))
+
+
+def _read_metadata(path: str) -> Metadata:
+    """Parse the JSON `0.metadata` index into the Metadata dataclasses."""
+    with open(os.path.join(path, "0.metadata")) as f:
+        raw = json.load(f)
+    meta = Metadata(state_dict_metadata={}, storage_metadata={},
+                    flat_mapping=None)
+    for key, metas in raw["state_dict_metadata"].items():
+        meta.state_dict_metadata[key] = [
+            LocalTensorMetadata(tuple(m["global_offset"]),
+                                tuple(m["local_shape"]), m["dtype"],
+                                tuple(m["global_shape"])) for m in metas]
+    for name, fname in raw["storage_metadata"].items():
+        key, _, off = name.rpartition("@@")
+        offset = tuple(int(o) for o in off.split("_")) if off else ()
+        meta.storage_metadata[LocalTensorIndex(key, offset)] = fname
+    return meta
 
 
 def _assemble(dest_index, global_shape, saved_metas, storage, reader, key,
@@ -74,8 +95,7 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
     import jax
 
     _wait_pending()  # async saves must be on disk before we read
-    with open(os.path.join(path, "0.metadata"), "rb") as f:
-        meta: Metadata = pickle.load(f)
+    meta = _read_metadata(path)
     reader = _StorageReader(path)
 
     for key, t in state_dict.items():
@@ -84,7 +104,7 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
         saved = meta.state_dict_metadata[key]
         arr = t._data if isinstance(t, Tensor) else t
         global_shape = tuple(int(s) for s in arr.shape)
-        dtype = np.dtype(saved[0].dtype)
+        dtype = sft.np_dtype(saved[0].dtype)
         sharding = getattr(arr, "sharding", None)
         if sharding is None or not hasattr(arr, "addressable_shards"):
             full = _assemble(tuple(slice(0, s) for s in global_shape),

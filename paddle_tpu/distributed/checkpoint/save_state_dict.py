@@ -9,20 +9,33 @@ grouped into one `.distcp` file per owning device; process 0 writes the
 global `0.metadata` index. `async_save=True` snapshots shards to host and
 writes on a background thread (reference's async save copies to pinned CPU
 memory the same way).
+
+Storage format (round-3 VERDICT item 10): shard files are SAFETENSORS
+layout (JSON header + raw bytes + per-tensor crc32, written atomically via
+rename — see `framework/safetensors.py`), and the index is JSON. No pickle
+anywhere: loads execute no code and verify integrity checksum-first.
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import threading
 from typing import Dict, Optional
 
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...framework import safetensors as sft
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
 __all__ = ["save_state_dict"]
+
+FORMAT_TAG = "paddle_tpu.distcp.v2+safetensors"
+
+
+def shard_name(key: str, offset) -> str:
+    """Flat tensor name inside a shard file: `<key>@@<o0>_<o1>...`."""
+    return f"{key}@@{'_'.join(str(int(o)) for o in offset)}"
 
 _pending_saves = []
 
@@ -78,13 +91,30 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
 
     def write():
         for dev_id, blobs in per_device.items():
-            with open(os.path.join(path, f"{dev_id}_0.distcp"), "wb") as f:
-                pickle.dump(blobs, f)
+            tensors = {shard_name(k, off): host
+                       for (k, off), host in blobs.items()}
+            sft.save_file(tensors, os.path.join(path, f"{dev_id}_0.distcp"),
+                          metadata={"format": FORMAT_TAG})
         # the coordinator writes the global index last (its presence marks a
         # complete checkpoint)
         if jax.process_index() == coordinator_rank:
-            with open(os.path.join(path, "0.metadata"), "wb") as f:
-                pickle.dump(meta, f)
+            index = {
+                "format": FORMAT_TAG,
+                "state_dict_metadata": {
+                    k: [{"global_offset": list(m.global_offset),
+                         "local_shape": list(m.local_shape),
+                         "dtype": m.dtype,
+                         "global_shape": list(m.global_shape)}
+                        for m in metas]
+                    for k, metas in meta.state_dict_metadata.items()},
+                "storage_metadata": {
+                    shard_name(ix.tensor_key, ix.global_offset): fname
+                    for ix, fname in meta.storage_metadata.items()},
+            }
+            tmp = os.path.join(path, "0.metadata.tmp")
+            with open(tmp, "w") as f:
+                json.dump(index, f)
+            os.replace(tmp, os.path.join(path, "0.metadata"))
 
     if async_save:
         th = threading.Thread(target=write, daemon=False)

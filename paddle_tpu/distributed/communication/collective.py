@@ -480,17 +480,25 @@ class _PendingRecv:
 class _DeferredMailboxRecv:
     """Single-controller irecv handle: the mailbox pop happens at wait()
     time, so recv-before-send batch patterns complete once the matching
-    send has been posted."""
+    send has been posted. wait() is idempotent (pops exactly once);
+    is_completed() before wait() approximates NCCL semantics by reporting
+    message availability on the group channel."""
 
     def __init__(self, tensor, src, group):
         self._tensor = tensor
         self._src = src
         self._group = group
+        self._done = False
 
     def wait(self):
-        return recv(self._tensor, src=self._src, group=self._group)
+        if not self._done:
+            recv(self._tensor, src=self._src, group=self._group)
+            self._done = True
+        return self._tensor
 
     def is_completed(self):
+        if self._done:
+            return True
         q = _mailbox.get(_group(self._group).id)
         return bool(q)
 

@@ -21,30 +21,34 @@ def _num_segments(segment_ids: Tensor) -> int:
     return int(ids.max()) + 1 if ids.size else 0
 
 
+def segment_reduce_impl(x, ids, n, kind):
+    """The one segment-reduction kernel: sum/mean/max/min over dim0 groups,
+    empty segments filled with 0 (paddle semantics; jax fills +/-inf
+    identities). Shared by segment_* and geometric.message_passing."""
+    import jax
+    import jax.numpy as jnp
+
+    if kind == "sum":
+        return jax.ops.segment_sum(x, ids, num_segments=n)
+    if kind == "mean":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1)[(...,) + (None,) * (x.ndim - 1)]
+    out = (jax.ops.segment_max if kind == "max"
+           else jax.ops.segment_min)(x, ids, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.int32), ids,
+                            num_segments=n)
+    mask = (c > 0)[(...,) + (None,) * (x.ndim - 1)]
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
 def _segment(op_name, data, segment_ids, kind):
     data, segment_ids = _as_tensor(data), _as_tensor(segment_ids)
     n = _num_segments(segment_ids)
 
     def impl(x, ids, *, n, kind):
-        import jax
-        import jax.numpy as jnp
-
-        if kind == "sum":
-            return jax.ops.segment_sum(x, ids, num_segments=n)
-        if kind == "mean":
-            s = jax.ops.segment_sum(x, ids, num_segments=n)
-            c = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
-                                    num_segments=n)
-            return s / jnp.maximum(c, 1)[(...,) + (None,) * (x.ndim - 1)]
-        if kind == "max":
-            out = jax.ops.segment_max(x, ids, num_segments=n)
-        else:
-            out = jax.ops.segment_min(x, ids, num_segments=n)
-        # empty segments: paddle fills 0, jax fills +/-inf identities
-        c = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.int32), ids,
-                                num_segments=n)
-        mask = (c > 0)[(...,) + (None,) * (x.ndim - 1)]
-        return jnp.where(mask, out, jnp.zeros_like(out))
+        return segment_reduce_impl(x, ids, n, kind)
 
     if op_name not in dispatch.op_registry():
         dispatch.register_op(op_name, impl)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..core import dispatch
 from ..core.tensor import Tensor
+from .math import segment_reduce_impl as _scatter_reduce
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv"]
 
@@ -21,23 +22,6 @@ def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
-def _scatter_reduce(msg, dst, n, reduce_op):
-    import jax
-    import jax.numpy as jnp
-
-    if reduce_op == "sum":
-        return jax.ops.segment_sum(msg, dst, num_segments=n)
-    if reduce_op == "mean":
-        s = jax.ops.segment_sum(msg, dst, num_segments=n)
-        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
-                                num_segments=n)
-        return s / jnp.maximum(c, 1)[(...,) + (None,) * (msg.ndim - 1)]
-    out = (jax.ops.segment_max if reduce_op == "max"
-           else jax.ops.segment_min)(msg, dst, num_segments=n)
-    c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32), dst,
-                            num_segments=n)
-    mask = (c > 0)[(...,) + (None,) * (msg.ndim - 1)]
-    return jnp.where(mask, out, jnp.zeros_like(out))
 
 
 def _combine(a, b, message_op):

@@ -47,8 +47,15 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
             idx = np.arange(lo, hi)
         elif w_arr is not None:
             p = w_arr[lo:hi]
-            p = p / p.sum() if p.sum() > 0 else None
-            idx = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+            pos = np.flatnonzero(p > 0)
+            if len(pos) == 0:
+                idx = lo + rng.choice(deg, size=sample_size, replace=False)
+            elif len(pos) <= sample_size:
+                idx = lo + pos  # all positive-weight edges, nothing to draw
+            else:
+                pp = p[pos] / p[pos].sum()
+                idx = lo + rng.choice(pos, size=sample_size, replace=False,
+                                      p=pp)
         else:
             idx = lo + rng.choice(deg, size=sample_size, replace=False)
         out_n.append(row[idx])

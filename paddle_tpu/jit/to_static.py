@@ -12,12 +12,18 @@ collapses to two executable launches per step (the reference's hard part 1,
 SURVEY.md §7.3).
 
 Dynamic python control flow re-specialises per input signature (the
-"guard" role of SOT); data-dependent branches inside the traced code must
-use lax.cond-style ops, like any XLA program.
+"guard" role of SOT). Data-dependent branches on tensor VALUES cannot
+compile into one XLA program; the reference's SOT breaks the graph and
+runs such frames eagerly (`jit/sot/translate.py:31`). Same contract here:
+a concretization/tracer error during tracing triggers a one-time warning
+and the signature falls back to eager execution permanently (the
+graph-break cache), so the model still trains — just without whole-program
+compilation for that signature.
 """
 from __future__ import annotations
 
 import itertools
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -30,6 +36,16 @@ __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "ignore_module"]
 
 _counter = itertools.count()
+
+
+def _GRAPH_BREAK_ERRORS():
+    """Tracer/concretization error types that signal a data-dependent
+    Python branch (evaluated lazily: jax import stays off the module's
+    import path)."""
+    import jax.errors as je
+
+    return (je.TracerBoolConversionError, je.ConcretizationTypeError,
+            je.TracerArrayConversionError, je.TracerIntegerConversionError)
 
 
 class InputSpec:
@@ -63,6 +79,7 @@ class StaticFunction:
         self._id = next(_counter)
         self._out_structs: Dict[tuple, Any] = {}
         self._op_registered = False
+        self._fallback_keys: set = set()  # signatures that graph-broke
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     # -- signature key -------------------------------------------------------
@@ -128,6 +145,8 @@ class StaticFunction:
         training = bool(self._layer.training) if self._layer is not None \
             else True
         key = self._key(tensor_args, static_kwargs, training)
+        if key in self._fallback_keys:  # permanent eager path: no prep work
+            return self._run_eager(tensor_args, static_kwargs)
         self._ensure_op()
         params = self._param_items()
         param_tensors = []
@@ -138,10 +157,29 @@ class StaticFunction:
                  "param_names": tuple(k for k, _ in params),
                  "static_kwargs": tuple(sorted(static_kwargs.items())),
                  "key": key}
-        outs = dispatch.apply(f"to_static_{self._id}",
-                              list(param_tensors) + tensor_args, attrs)
+        try:
+            outs = dispatch.apply(f"to_static_{self._id}",
+                                  list(param_tensors) + tensor_args, attrs)
+        except _GRAPH_BREAK_ERRORS() as e:
+            # SOT graph-break analog: the forward branches on a tensor VALUE
+            # (if t.item(): / int(t) / np.array(t)), which cannot live in one
+            # traced program. Run this signature eagerly from now on.
+            warnings.warn(
+                f"to_static({self.__name__}): data-dependent Python control "
+                f"flow broke whole-program capture "
+                f"({type(e).__name__}); falling back to eager execution "
+                "for this input signature. Use paddle.static.nn.cond / "
+                "lax-style ops to keep the branch inside the compiled "
+                "program.", stacklevel=2)
+            self._fallback_keys.add(key)
+            return self._run_eager(tensor_args, static_kwargs)
         struct = self._out_structs.get(key)
         return _unflatten_out(outs, struct)
+
+    def _run_eager(self, tensor_args, static_kwargs):
+        """Graph-break path: the original function over normal eager
+        dispatch — per-op executables, autograd tape intact."""
+        return self._function(*tensor_args, **static_kwargs)
 
     # -- reference-parity helpers -------------------------------------------
     @property

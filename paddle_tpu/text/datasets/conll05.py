@@ -100,6 +100,14 @@ class Conll05st(Dataset):
                     else:
                         sent.append(word)
                         seg.append(cols)
+                if seg:  # no trailing blank line: flush the last sentence
+                    by_col = [[row[i] for row in seg]
+                              for i in range(len(seg[0]))]
+                    verbs = [v for v in by_col[0] if v != "-"]
+                    for i, col in enumerate(by_col[1:]):
+                        self.sentences.append(sent)
+                        self.predicates.append(verbs[i])
+                        self.labels.append(self._parse_props(col))
 
     def __getitem__(self, idx):
         sentence = self.sentences[idx]
