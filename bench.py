@@ -226,6 +226,54 @@ def _eager_microbench():
     return out
 
 
+def _decode_microbench(on_tpu: bool):
+    """bf16 vs int8-weight-only decode throughput (round-3 VERDICT item 2
+    'done' bar). 7B layer shapes on TPU (2 layers fit comfortably), tiny
+    shapes on CPU; reports tokens/sec for both weight formats."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.llama_runner import LlamaInferenceEngine
+    from paddle_tpu.models import llama_7b_shaped, llama_tiny
+
+    model = llama_7b_shaped(num_layers=2) if on_tpu else \
+        llama_tiny(layers=2, hidden=128, heads=4, seq=64)
+    model.eval()
+    batch = 8 if on_tpu else 2
+    prompt = np.ones((batch, 8), np.int32)
+    out = {}
+    for mode, kw in (("bf16", {"dtype": "bfloat16"} if on_tpu else {}),
+                     ("int8", ({"dtype": "bfloat16"} if on_tpu else {})
+                      | {"weight_only": "int8"})):
+        eng = LlamaInferenceEngine(model, max_batch_size=batch,
+                                   num_blocks=batch * 16 + 8, **kw)
+        tables = np.zeros((batch, eng.manager.max_blocks_per_seq), np.int32)
+        for b in range(batch):
+            tables[b] = np.arange(eng.manager.max_blocks_per_seq) \
+                + b * eng.manager.max_blocks_per_seq
+        logits = eng.prefill(prompt, tables)
+        toks = np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+        lens = np.full((batch,), prompt.shape[1], np.int32)
+        # warm the decode executable
+        l2 = eng.decode_step(toks, lens, tables)
+        jax.block_until_ready(l2)
+        steps = 32 if on_tpu else 8
+        t0 = time.perf_counter()
+        for i in range(steps):
+            l2 = eng.decode_step(toks, lens + 1 + i, tables)
+        jax.block_until_ready(l2)
+        dt = (time.perf_counter() - t0) / steps
+        out[f"{mode}_decode_tok_per_sec"] = round(batch / dt, 1)
+        out[f"{mode}_decode_step_ms"] = round(dt * 1e3, 2)
+        del eng
+    if out.get("bf16_decode_step_ms"):
+        out["int8_speedup"] = round(
+            out["bf16_decode_step_ms"] / out["int8_decode_step_ms"], 2)
+    return out
+
+
 def main():
     extras = {}
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
@@ -439,6 +487,13 @@ def main():
         extras["eager_dispatch"] = _eager_microbench()
     except Exception as e:
         extras["eager_dispatch"] = f"{type(e).__name__}: {str(e)[:160]}"
+    gc.collect()
+
+    # bf16 vs int8 weight-only decode (round-3 VERDICT item 2)
+    try:
+        extras["weight_only_decode"] = _decode_microbench(on_tpu)
+    except Exception as e:
+        extras["weight_only_decode"] = f"{type(e).__name__}: {str(e)[:160]}"
     gc.collect()
 
     # flash-vs-sdpa microbench on the measured attention shape

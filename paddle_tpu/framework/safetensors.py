@@ -31,12 +31,11 @@ _TAG_TO_DTYPE = {v: k for k, v in _DTYPE_TO_TAG.items()}
 
 
 def np_dtype(name: str) -> np.dtype:
-    """Resolve a dtype NAME (incl. numpy-extension float types) to np.dtype."""
-    if name in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
-        import ml_dtypes
+    """Resolve a dtype NAME (incl. numpy-extension float types) to np.dtype
+    via the framework's single dtype registry."""
+    from . import dtype as dtype_mod
 
-        return np.dtype(getattr(ml_dtypes, name))
-    return np.dtype(name)
+    return np.dtype(dtype_mod.to_np(name))
 
 
 def save_file(tensors: Dict[str, np.ndarray], path: str,

@@ -74,6 +74,42 @@ def _stack_llama_params(model: LlamaForCausalLM):
     return params
 
 
+_QUANT_KEYS = ("qkv_w", "o_w", "gate_up_w", "down_w")
+
+
+def _quantize_stacked(params, algo: str):
+    """Weight-only-quantize the stacked [L, K, N] projection weights:
+    -> {"q": int8/fp8 [L, N, K], "s": f32 [L, N]} per key (per-layer,
+    per-out-channel scales), via the shared `nn.quant.per_channel_quantize`
+    formulas."""
+    import jax.numpy as jnp
+
+    from ..nn.quant import per_channel_quantize
+
+    if algo not in ("int8", "fp8"):
+        raise ValueError(f"weight_only must be 'int8' or 'fp8', got {algo}")
+    out = dict(params)
+    for key in _QUANT_KEYS:
+        w = jnp.swapaxes(params[key].astype(jnp.float32), 1, 2)  # [L, N, K]
+        q, scale = per_channel_quantize(
+            w, "weight_only_int8" if algo == "int8" else "fp8")
+        out[key] = {"q": q, "s": scale}
+    return out
+
+
+def _mm(x, w):
+    """x [..., K] @ layer weight: dense [K, N] array (einsum) or
+    weight-only-quantized {"q": [N, K], "s": [N]} via the shared
+    `nn.quant.dequant_matmul` (Pallas kernel on aligned TPU shapes)."""
+    import jax.numpy as jnp
+
+    if not isinstance(w, dict):
+        return jnp.einsum("...k,kn->...n", x, w.astype(x.dtype))
+    from ..nn.quant import dequant_matmul
+
+    return dequant_matmul(x, w["q"], w["s"])
+
+
 def _rms(x, w, eps):
     import jax
     import jax.numpy as jnp
@@ -102,7 +138,12 @@ class LlamaInferenceEngine:
 
     def __init__(self, model: LlamaForCausalLM, max_batch_size: int = 8,
                  num_blocks: int = 256, block_size: int = 16,
-                 max_blocks_per_seq: int = 16, dtype=None):
+                 max_blocks_per_seq: int = 16, dtype=None,
+                 weight_only: str | None = None):
+        """`weight_only='int8'|'fp8'` stores the projection weights
+        quantized per-channel and dequantizes inside the gemm — the
+        decode-bandwidth path of the reference's cutlass int8/fp8 kernels
+        (`phi/kernels/fusion/cutlass/gemm_epilogue/`)."""
         import jax
         import jax.numpy as jnp
 
@@ -117,6 +158,9 @@ class LlamaInferenceEngine:
             self.params = {k: v.astype(dtype) if v.dtype in
                            (jnp.float32, jnp.bfloat16, jnp.float16) else v
                            for k, v in self.params.items()}
+        self.weight_only = weight_only
+        if weight_only is not None:
+            self.params = _quantize_stacked(self.params, weight_only)
         cdtype = self.params["embed"].dtype
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
@@ -245,7 +289,7 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, decode):
     nh, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     h1 = _rms(x, ln1, cfg.eps)
-    qkv = jnp.einsum("bsh,ho->bso", h1, qkv_w.astype(h1.dtype))
+    qkv = _mm(h1, qkv_w)
     q = qkv[..., :nh * d].reshape(b, s, nh, d)
     k = qkv[..., nh * d:(nh + kvh) * d].reshape(b, s, kvh, d)
     v = qkv[..., (nh + kvh) * d:].reshape(b, s, kvh, d)
@@ -274,13 +318,13 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, decode):
 
         attn = _sdpa_fn(q, kk, vv, None, True, None, False)
         attn = attn.reshape(b, s, nh * d)
-    x = x + jnp.einsum("bso,oh->bsh", attn, o_w.astype(attn.dtype))
+    x = x + _mm(attn, o_w)
 
     h2 = _rms(x, ln2, cfg.eps)
-    gu = jnp.einsum("bsh,hi->bsi", h2, gu_w.astype(h2.dtype))
+    gu = _mm(h2, gu_w)
     g, u = jnp.split(gu, 2, axis=-1)
     act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
-    x = x + jnp.einsum("bsi,ih->bsh", act, down_w.astype(act.dtype))
+    x = x + _mm(act, down_w)
     return x, (kc, vc)
 
 

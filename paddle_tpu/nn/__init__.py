@@ -6,3 +6,4 @@ from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
 from .parameter import Parameter, ParamAttr, create_parameter  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer  # noqa: F401
+from . import quant  # noqa: F401,E402  (needs Layer; must import last)

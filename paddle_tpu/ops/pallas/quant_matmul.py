@@ -1,0 +1,110 @@
+"""Pallas weight-only quantized matmul (reference:
+`paddle/phi/kernels/fusion/cutlass/gemm_epilogue/` int8/fp8 gemm +
+dequant epilogues).
+
+TPU-first rationale: weight-only decode is HBM-bandwidth-bound, so the win
+comes from READING int8/fp8 weights (2x fewer bytes than bf16) and
+dequantizing inside VMEM right before the MXU — the bf16 weight matrix
+never exists in HBM. The kernel tiles (M, N, K), accumulates in f32 over
+the K grid axis, and applies the per-output-channel scale once at the last
+K step.
+
+Layout contract matches the reference `weight_quantize`: quantized weight
+is [N, K] (transposed), scale is [N] f32. int4 / non-TPU fall back to the
+XLA composite in `nn/quant` (convert fuses into the matmul there too).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import _support
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k):
+    """One (i, j, k) grid step: acc += x[i,k] @ dequant(w[j,k]).T"""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                              # [bm, bk] bf16/f32
+    w = w_ref[...].astype(x.dtype)              # [bn, bk] int8/fp8 -> x dtype
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())),         # contract K, w transposed
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _done():
+        scale = s_ref[...].astype(jnp.float32)  # [bn]
+        o_ref[...] = (acc_ref[...] * scale[None, :]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def quant_matmul(x2d, wq, scale, out_dtype=None):
+    """x2d [M, K] (bf16/f32) @ dequant(wq [N, K], scale [N]) -> [M, N].
+    Differentiable w.r.t. x2d only (weights are quantized constants);
+    backward is an XLA dequant-matmul (bandwidth-light: runs on the grad,
+    not the weights' hot decode path)."""
+    return _quant_matmul_fwd_only(x2d, wq, scale, out_dtype)
+
+
+def _quant_matmul_fwd_rule(x2d, wq, scale, out_dtype):
+    return _quant_matmul_fwd_only(x2d, wq, scale, out_dtype), (wq, scale)
+
+
+def _quant_matmul_bwd_rule(out_dtype, res, g):
+    import numpy as np
+
+    wq, scale = res
+    wf = wq.astype(g.dtype) * scale[:, None].astype(g.dtype)   # [N, K]
+    # int8 weights take a float0 (symbolic-zero) cotangent
+    wq_ct = np.zeros(wq.shape, jax.dtypes.float0)
+    return g @ wf, wq_ct, jnp.zeros_like(scale)
+
+
+quant_matmul.defvjp(_quant_matmul_fwd_rule, _quant_matmul_bwd_rule)
+
+
+def _quant_matmul_fwd_only(x2d, wq, scale, out_dtype=None):
+    m, k = x2d.shape
+    n, k2 = wq.shape
+    assert k == k2, (x2d.shape, wq.shape)
+    out_dtype = out_dtype or x2d.dtype
+
+    bm = _support.pick_block(m, 256) or m
+    bn = _support.pick_block(n, 512) or n
+    bk = _support.pick_block(k, 512) or k
+    n_k = pl.cdiv(k, bk)
+
+    return _support.pallas_call(
+        functools.partial(_qmm_kernel, n_k=n_k),
+        grid=(pl.cdiv(m, bm), pl.cdiv(n, bn), n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bn,), lambda i, j, kk: (j,)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        # f32 accumulator carried across the K grid axis
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_support.interpret_mode(),
+    )(x2d, wq, scale)
+
+
+def supported(x_shape, w_shape, w_dtype) -> bool:
+    """Pallas path: int8/fp8 2-D weights, dims divisible into legal tiles."""
+    import numpy as np
+
+    if len(x_shape) < 1 or len(w_shape) != 2:
+        return False
+    name = np.dtype(w_dtype).name if not isinstance(w_dtype, str) else w_dtype
+    return name in ("int8", "float8_e4m3fn", "float8_e5m2")

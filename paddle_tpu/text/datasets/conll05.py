@@ -76,6 +76,17 @@ class Conll05st(Dataset):
 
     def _load_anno(self):
         self.sentences, self.predicates, self.labels = [], [], []
+
+        def flush(sent, seg):
+            if not seg:
+                return
+            by_col = [[row[i] for row in seg] for i in range(len(seg[0]))]
+            verbs = [v for v in by_col[0] if v != "-"]
+            for i, col in enumerate(by_col[1:]):
+                self.sentences.append(sent)
+                self.predicates.append(verbs[i])
+                self.labels.append(self._parse_props(col))
+
         with tarfile.open(self.data_file) as tf:
             wf = tf.extractfile(
                 "conll05st-release/test.wsj/words/test.wsj.words.gz")
@@ -88,26 +99,12 @@ class Conll05st(Dataset):
                     word = wline.strip().decode()
                     cols = pline.strip().decode().split()
                     if not cols:          # sentence boundary
-                        if seg:
-                            by_col = [[row[i] for row in seg]
-                                      for i in range(len(seg[0]))]
-                            verbs = [v for v in by_col[0] if v != "-"]
-                            for i, col in enumerate(by_col[1:]):
-                                self.sentences.append(sent)
-                                self.predicates.append(verbs[i])
-                                self.labels.append(self._parse_props(col))
+                        flush(sent, seg)
                         sent, seg = [], []
                     else:
                         sent.append(word)
                         seg.append(cols)
-                if seg:  # no trailing blank line: flush the last sentence
-                    by_col = [[row[i] for row in seg]
-                              for i in range(len(seg[0]))]
-                    verbs = [v for v in by_col[0] if v != "-"]
-                    for i, col in enumerate(by_col[1:]):
-                        self.sentences.append(sent)
-                        self.predicates.append(verbs[i])
-                        self.labels.append(self._parse_props(col))
+                flush(sent, seg)  # file may end without a blank line
 
     def __getitem__(self, idx):
         sentence = self.sentences[idx]

