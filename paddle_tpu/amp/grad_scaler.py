@@ -64,9 +64,20 @@ class AmpScaler:
         import jax
         import jax.numpy as jnp
 
-        params = [p for p in optimizer._params
-                  if isinstance(p, Tensor) and not p.stop_gradient
-                  and p.grad is not None]
+        all_params = [p for p in optimizer._params
+                      if isinstance(p, Tensor) and not p.stop_gradient
+                      and p.grad is not None]
+        # SelectedRows grads: unscale values in place + finite-check them
+        sparse_inf = False
+        params = []
+        for p in all_params:
+            g = p.grad
+            if getattr(g, "is_selected_rows", False):
+                inv = jnp.asarray(1.0 / self._loss_scaling, jnp.float32)
+                p._grad = g.scaled(inv)
+                sparse_inf |= not bool(jnp.isfinite(p._grad.values).all())
+            else:
+                params.append(p)
         if params:
             if self._unscale_fn is None:
                 @jax.jit
@@ -83,9 +94,9 @@ class AmpScaler:
             new_grads, found_inf = self._unscale_fn(grads, inv)
             for p, g in zip(params, new_grads):
                 p.grad._data = g
-            self._found_inf = bool(found_inf)
+            self._found_inf = bool(found_inf) or sparse_inf
         else:
-            self._found_inf = False
+            self._found_inf = sparse_inf
         self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
 
     unscale_ = _unscale

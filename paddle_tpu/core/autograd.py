@@ -238,6 +238,14 @@ def _add(a, b):
         return b
     if b is None:
         return a
+    a_sp = getattr(a, "is_selected_rows", False)
+    b_sp = getattr(b, "is_selected_rows", False)
+    if a_sp and b_sp:
+        return a.concat(b)
+    if a_sp:
+        return a.to_dense() + b   # mixed: correctness over sparsity
+    if b_sp:
+        return a + b.to_dense()
     return a + b
 
 
@@ -321,7 +329,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         g = cts[oidx] if cts is not None else None
         for i in idxs:
             if g is not None:
-                if create_graph:
+                if getattr(g, "is_selected_rows", False):
+                    # the paddle.grad contract returns Tensors; densify
+                    results[i] = Tensor(g.to_dense(), stop_gradient=True)
+                elif create_graph:
                     results[i] = g  # Tensor, still on the tape
                 else:
                     results[i] = Tensor(g, stop_gradient=True)
@@ -370,6 +381,10 @@ def _apply_hooks(node, cts):
     for i, hooks in enumerate(node.out_hooks):
         if not hooks or new[i] is None:
             continue
+        if getattr(new[i], "is_selected_rows", False):
+            # user hooks take dense Tensors: densify this cotangent (the
+            # hook opted the param out of the sparse fast path)
+            new[i] = new[i].to_dense()
         g = Tensor(new[i], stop_gradient=True)
         for h in list(hooks):
             r = h(g)
@@ -471,7 +486,20 @@ def _apply_hooks_diff(node, cts):
 def _accumulate_into_grad(t, ct):
     from .tensor import Tensor
 
+    if getattr(ct, "is_selected_rows", False):
+        # row-sparse gradient (SelectedRows): stored AS the grad object —
+        # optimizers sparse-apply it; .to_dense() is the user escape hatch
+        prev = t._grad
+        if prev is None:
+            t._grad = ct
+        elif getattr(prev, "is_selected_rows", False):
+            t._grad = prev.concat(ct)
+        else:
+            t._grad = Tensor(prev._data + ct.to_dense(), stop_gradient=True)
+        return
     if t.grad is None:
         t._grad = Tensor(ct, stop_gradient=True)
+    elif getattr(t._grad, "is_selected_rows", False):
+        t._grad = Tensor(t._grad.to_dense() + ct, stop_gradient=True)
     else:
         t._grad = Tensor(t._grad._data + ct, stop_gradient=True)

@@ -1,0 +1,103 @@
+"""SelectedRows: row-sparse gradients (reference:
+`paddle/phi/core/selected_rows.h` + `phi/kernels/selected_rows/`).
+
+Large-vocab embedding backward must not materialize a dense [V, H]
+gradient — the cotangent touches only the looked-up rows. A SelectedRows
+carries (rows [n], values [n, ...], height V); `rows` may contain
+duplicates (one entry per token occurrence). Consumers merge duplicates
+with STATIC shapes (`merged_static`) so optimizer executables are reused
+across batches: `jnp.unique(..., size=n)` pads unused slots with row id
+`height`, which every scatter then drops via OOB mode='drop' — the TPU way
+to keep a data-dependent unique count out of the compiled program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows", "merge_rows_static"]
+
+
+def merge_rows_static(rows, values, height: int):
+    """(u_rows [n], merged_values [n, ...]) with duplicate rows summed,
+    STATIC output size n = len(rows): `jnp.unique(size=n)` pads unused
+    slots with row id `height` (zero values), which scatters drop as OOB.
+    The one implementation of the merge trick — used by SelectedRows and
+    the optimizers' jitted sparse step."""
+    import jax
+    import jax.numpy as jnp
+
+    n = rows.shape[0]
+    u_rows, inv = jnp.unique(rows, return_inverse=True, size=n,
+                             fill_value=height)
+    merged = jax.ops.segment_sum(values, inv.reshape(-1), num_segments=n)
+    return u_rows, merged
+
+
+class SelectedRows:
+    is_selected_rows = True
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # [n] int array (device)
+        self.values = values      # [n, ...] array (device)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + [int(s) for s in self.values.shape[1:]]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        """Gradient accumulation: stack occurrence lists (no merge yet)."""
+        import jax.numpy as jnp
+
+        assert self.height == other.height
+        return SelectedRows(
+            jnp.concatenate([self.rows, other.rows]),
+            jnp.concatenate([self.values, other.values]), self.height)
+
+    def to_dense(self):
+        """Dense [height, ...] gradient (scatter-add). The fallback path —
+        using it defeats the memory savings; optimizers go through
+        merged_static instead."""
+        import jax.numpy as jnp
+
+        z = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                      self.values.dtype)
+        return z.at[self.rows].add(self.values)
+
+    def merged_static(self):
+        """(u_rows [n], merged_values [n, ...]) with duplicates summed
+        (see `merge_rows_static`)."""
+        return merge_rows_static(self.rows, self.values, self.height)
+
+    def merged(self) -> "SelectedRows":
+        """A duplicate-free equivalent (padded slots carry row id `height`
+        and zero values, dropped by any later scatter)."""
+        u_rows, merged = self.merged_static()
+        return SelectedRows(u_rows, merged, self.height)
+
+    def scaled(self, factor) -> "SelectedRows":
+        """Values scaled by a scalar (grad clip / loss-scale unscale)."""
+        return SelectedRows(self.rows,
+                            self.values * factor.astype(self.values.dtype)
+                            if hasattr(factor, "astype")
+                            else self.values * factor, self.height)
+
+    def sq_sum(self):
+        """Sum of squares of the MERGED gradient (duplicate rows summed
+        first — the correct global-norm contribution)."""
+        import jax.numpy as jnp
+
+        _, merged = self.merged_static()
+        return jnp.sum(merged.astype(jnp.float32) ** 2)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={self.rows.shape[0]}, "
+                f"values={tuple(self.values.shape)})")

@@ -101,7 +101,14 @@ class Tensor:
         if set_to_zero and self._grad is not None:
             import jax.numpy as jnp
 
-            self._grad = Tensor(jnp.zeros_like(self._grad._data), stop_gradient=True)
+            if getattr(self._grad, "is_selected_rows", False):
+                # zero grad of a sparse param is dense zeros of the full shape
+                self._grad = Tensor(jnp.zeros(tuple(self._grad.shape),
+                                              self._grad.dtype),
+                                    stop_gradient=True)
+            else:
+                self._grad = Tensor(jnp.zeros_like(self._grad._data),
+                                    stop_gradient=True)
         else:
             self._grad = None
 

@@ -362,7 +362,10 @@ class PipelineParallel:
                         if p.grad is None:
                             p.grad = Tensor(gp._data, stop_gradient=True)
                         else:
-                            p.grad = Tensor(p.grad._data + gp._data,
+                            prev = (p.grad.to_dense()
+                                    if getattr(p.grad, "is_selected_rows",
+                                               False) else p.grad._data)
+                            p.grad = Tensor(prev + gp._data,
                                             stop_gradient=True)
         self.peak_live_activations = peak
         total = losses[0]

@@ -29,6 +29,13 @@ class ClipGradByValue(ClipGradBase):
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
+            if getattr(g, "is_selected_rows", False):
+                import jax.numpy as jnp
+
+                m = g.merged()  # clip applies to the MERGED gradient
+                m.values = jnp.clip(m.values, self.min, self.max)
+                out.append((p, m))
+                continue
             out.append((p, g.clip(self.min, self.max)))
         return out
 
@@ -44,6 +51,12 @@ class ClipGradByNorm(ClipGradBase):
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if getattr(g, "is_selected_rows", False):
+                norm = jnp.sqrt(g.sq_sum())
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                    1.0)
+                out.append((p, g.scaled(scale)))
                 continue
             norm = jnp.sqrt((g._data.astype(jnp.float32) ** 2).sum())
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
@@ -70,28 +83,37 @@ class ClipGradByGlobalNorm(ClipGradBase):
         import jax
         import jax.numpy as jnp
 
-        gs = [g._data for p, g in params_grads
-              if g is not None and getattr(p, "need_clip", True)]
-        if not gs:
+        active = [(p, g) for p, g in params_grads
+                  if g is not None and getattr(p, "need_clip", True)]
+        sparse_sq = sum(g.sq_sum() for p, g in active
+                        if getattr(g, "is_selected_rows", False))
+        gs = [g._data for p, g in active
+              if not getattr(g, "is_selected_rows", False)]
+        if not gs and not [1 for p, g in active
+                           if getattr(g, "is_selected_rows", False)]:
             return params_grads
         # Grads may live on disjoint device sets (pipeline stages place each
         # stage's params on its pp coordinate): reduce each grad's square sum
         # where it lives, hop the scalar partials to one device to combine,
         # then hop the scale back to each grad's devices.
-        keys = {self._dev_key(g) for g in gs}
+        keys = {self._dev_key(g) for g in gs} or {None}
         if len(keys) == 1:
-            global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+            global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                            for g in gs) + sparse_sq
         else:
             home = gs[0].sharding
             partials = [jax.device_put(jnp.sum(g.astype(jnp.float32) ** 2),
                                        home) for g in gs]
-            global_sq = sum(partials)
+            global_sq = sum(partials) + sparse_sq
         global_norm = jnp.sqrt(global_sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if getattr(g, "is_selected_rows", False):
+                out.append((p, g.scaled(scale)))
                 continue
             s = scale if len(keys) == 1 else jax.device_put(scale,
                                                             g._data.sharding)
@@ -106,18 +128,31 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
 
     if isinstance(parameters, Tensor):
         parameters = [parameters]
-    grads = [p.grad._data for p in parameters if p.grad is not None]
-    if not grads:
+    sparse = [p.grad for p in parameters if p.grad is not None
+              and getattr(p.grad, "is_selected_rows", False)]
+    grads = [p.grad._data for p in parameters if p.grad is not None
+             and not getattr(p.grad, "is_selected_rows", False)]
+    if not grads and not sparse:
         return Tensor(jnp.asarray(0.0))
     if norm_type == float("inf"):
-        total = jnp.max(jnp.stack([jnp.abs(g).max() for g in grads]))
+        parts = [jnp.abs(g).max() for g in grads] + \
+            [jnp.abs(s.merged_static()[1]).max() for s in sparse]
+        total = jnp.max(jnp.stack(parts))
     else:
-        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
-                    for g in grads) ** (1.0 / norm_type)
+        total = (sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                     for g in grads)
+                 + sum(jnp.sum(jnp.abs(s.merged_static()[1].astype(
+                     jnp.float32)) ** norm_type) for s in sparse)
+                 ) ** (1.0 / norm_type)
     clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
     for p in parameters:
-        if p.grad is not None:
-            p.grad._data = p.grad._data * clip_coef.astype(p.grad._data.dtype)
+        g = p.grad
+        if g is None:
+            continue
+        if getattr(g, "is_selected_rows", False):
+            p._grad = g.scaled(clip_coef)
+        else:
+            g._data = g._data * clip_coef.astype(g._data.dtype)
     return Tensor(total)
 
 

@@ -10,6 +10,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...core import autograd as autograd_mod
 from ...core import dispatch
 from ...core.tensor import Tensor
 from ...framework import random as random_mod
@@ -58,13 +59,68 @@ def _embedding_fn(ids, w, padding_idx):
 dispatch.register_op("embedding", _embedding_fn)
 
 
+class _SparseEmbeddingGradNode(autograd_mod.GradNodeBase):
+    """Embedding backward producing a SelectedRows gradient (reference
+    `phi/kernels/selected_rows/` embedding-grad): rows = looked-up ids,
+    values = the arriving cotangent rows — the dense [V, H] gradient is
+    never built."""
+
+    __slots__ = ("indices", "height", "padding_idx")
+
+    def __init__(self, indices, height, padding_idx):
+        super().__init__("embedding_sparse_grad", 1)
+        self.indices = indices
+        self.height = height
+        self.padding_idx = padding_idx
+
+    def run(self, cotangents):
+        import jax.numpy as jnp
+
+        from ...core.selected_rows import SelectedRows
+
+        ct = cotangents[0]
+        if ct is None:
+            return [None]
+        rows = self.indices.reshape(-1).astype(jnp.int32)
+        vals = jnp.reshape(ct, (rows.shape[0], ct.shape[-1]))
+        if self.padding_idx is not None:
+            vals = jnp.where((rows == self.padding_idx)[:, None],
+                             jnp.zeros((), vals.dtype), vals)
+        return [SelectedRows(rows, vals, self.height)]
+
+    def release(self):
+        self.indices = None
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     x, weight = as_tensor(x), as_tensor(weight)
     if padding_idx is not None:
         padding_idx = int(padding_idx)
         if padding_idx < 0:
             padding_idx += int(weight.shape[0])
-    return dispatch.apply("embedding", [x, weight], {"padding_idx": padding_idx})
+    # SelectedRows backward: only for a LEAF weight in eager mode (a derived
+    # weight's producer node expects a dense cotangent; tracing has no tape).
+    use_sparse = (
+        sparse and autograd_mod.is_grad_enabled()
+        and not weight.stop_gradient
+        and weight._grad_node is None
+        and not dispatch._is_tracer(weight._data)
+        and not dispatch._is_tracer(x._data))
+    if not use_sparse:
+        return dispatch.apply("embedding", [x, weight],
+                              {"padding_idx": padding_idx})
+    with autograd_mod.no_grad():
+        out = dispatch.apply("embedding", [x, weight],
+                             {"padding_idx": padding_idx})
+    node = _SparseEmbeddingGradNode(x._data, int(weight.shape[0]),
+                                    padding_idx)
+    node.out_avals = [(out._data.shape, out._data.dtype)]
+    node.out_hooks.append(out._hooks)
+    node.edges = [(weight._ensure_accum_node(), 0)]
+    out.stop_gradient = False
+    out._grad_node = node
+    out._out_index = 0
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -203,13 +203,93 @@ class Optimizer:
                         and p.grad is not None]
         if not params_grads:
             return
+        # clip first (the clip classes understand SelectedRows), THEN split:
+        # SelectedRows gradients take the sparse-apply path (reference
+        # `phi/kernels/selected_rows/` adam/sgd); dense ones the fused step
         params_grads = self._clip_grads(params_grads)
+        sparse_pairs = [(p, g) for p, g in params_grads
+                        if getattr(g, "is_selected_rows", False)]
+        params_grads = [(p, g) for p, g in params_grads
+                        if not getattr(g, "is_selected_rows", False)]
         self._global_step += 1
         groups = {}
         for p, g in params_grads:
             groups.setdefault(self._placement_key(p), []).append((p, g))
         for dev_key, pg in groups.items():
             self._step_group(pg, dev_key)
+        for p, sr in sparse_pairs:
+            self._sparse_apply(p, sr)
+
+    def _build_sparse_step_fn(self, wd_kind, acc_row_shaped, has_master):
+        """One jitted row-sparse update: merge duplicate rows with STATIC
+        shapes (`selected_rows.merge_rows_static` — unique padded with row
+        id V, scatters drop it as OOB), run the subclass `_update_one` on
+        just the touched row slices, scatter back. With multi_precision the
+        f32 master rows are the working copy (param rows re-derived from
+        them). Executable reuse keyed on (n_rows, param shape, wd)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.selected_rows import merge_rows_static
+
+        wd, kind = wd_kind
+
+        def fn(param, master, rows, vals, accs, lr):
+            height = param.shape[0]
+            u_rows, merged = merge_rows_static(rows, vals, height)
+            src = master if master is not None else param
+            work = src[u_rows]                         # OOB gather clamps;
+            g = merged.astype(work.dtype)              # dropped at scatter
+            plr = lr
+            if wd and kind == "l2":
+                g = g + wd * work
+            elif wd and kind == "l1":
+                g = g + wd * jnp.sign(work)
+            elif wd and kind == "decoupled":
+                work = work - plr.astype(work.dtype) * wd * work
+            a = {k: (accs[k][u_rows] if acc_row_shaped[k] else accs[k])
+                 for k in accs}
+            new_work, new_a = self._update_one(work, g, a, plr, wd)
+            out_p = param.at[u_rows].set(new_work.astype(param.dtype),
+                                         mode="drop")
+            out_m = None if master is None else master.at[u_rows].set(
+                new_work.astype(master.dtype), mode="drop")
+            out_accs = {}
+            for k in accs:
+                if acc_row_shaped[k]:
+                    out_accs[k] = accs[k].at[u_rows].set(
+                        new_a[k].astype(accs[k].dtype), mode="drop")
+                else:
+                    out_accs[k] = new_a[k]
+            return out_p, out_m, out_accs
+
+        return jax.jit(fn, donate_argnums=(0, 1, 4) if has_master
+                       else (0, 4))
+
+    def _sparse_apply(self, p, sr):
+        """Lazy (touched-rows-only) update from a SelectedRows gradient."""
+        self._ensure_state(p)
+        accs = {k: self._accumulators[k][id(p)] for k in self._acc_names}
+        acc_row_shaped = {
+            k: tuple(getattr(accs[k], "shape", ())[:1]) == tuple(
+                p._data.shape[:1]) for k in accs}
+        master = self._master_weights.get(id(p))
+        wd_kind = self._wd_of(p)
+        key = ("sparse", tuple(p._data.shape), int(sr.rows.shape[0]),
+               wd_kind, tuple(sorted(acc_row_shaped.items())),
+               master is not None)
+        fn = self._jitted_updates.get(key)
+        if fn is None:
+            fn = self._jitted_updates[key] = self._build_sparse_step_fn(
+                wd_kind, acc_row_shaped, master is not None)
+        lr = self._lr_array * self._lr_mult_of(p)
+        new_p, new_m, new_accs = fn(p._data, master, sr.rows, sr.values,
+                                    accs, lr)
+        p._data = new_p
+        if new_m is not None:
+            self._master_weights[id(p)] = new_m
+        for k in self._acc_names:
+            self._accumulators[k][id(p)] = new_accs[k]
 
     def _step_group(self, params_grads, dev_key):
         for p, _ in params_grads:
@@ -581,6 +661,11 @@ class LBFGS(Optimizer):
         params = [p for p in self._params
                   if isinstance(p, Tensor) and not p.stop_gradient
                   and p.grad is not None]
+        if any(getattr(p.grad, "is_selected_rows", False) for p in params):
+            raise RuntimeError(
+                "LBFGS keeps dense curvature history and does not support "
+                "SelectedRows gradients; use Embedding(sparse=False) or a "
+                "first-order optimizer (SGD/Adam lazy_mode)")
         flat_grad = self._flat([p.grad._data for p in params])
         if self._prev_flat_grad is not None:
             flat_params = self._flat([p._data for p in params])

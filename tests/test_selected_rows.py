@@ -1,0 +1,265 @@
+"""SelectedRows sparse embedding gradients + lazy optimizer apply
+(round-3 VERDICT item 7; reference `phi/core/selected_rows.h`,
+`phi/kernels/selected_rows/adam_kernel.cc`).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.core.tensor import Tensor
+
+
+def _loss(emb, ids):
+    return (emb(Tensor(ids)) ** 2).sum()
+
+
+class TestSparseGrad:
+    def test_grad_is_selected_rows_not_dense(self):
+        emb = nn.Embedding(1000, 16, sparse=True)
+        ids = np.array([[3, 7, 3], [1, 999, 7]])
+        _loss(emb, ids).backward()
+        g = emb.weight.grad
+        assert getattr(g, "is_selected_rows", False)
+        assert g.height == 1000
+        assert list(g.values.shape) == [6, 16]  # one entry per occurrence
+        assert sorted(np.asarray(g.rows).tolist()) == [1, 3, 3, 7, 7, 999]
+
+    def test_sparse_matches_dense_grad(self):
+        paddle.seed(0)
+        ids = np.array([[3, 7, 3, 0]])
+        dense = nn.Embedding(50, 8, sparse=False)
+        sparse = nn.Embedding(50, 8, sparse=True)
+        import jax.numpy as jnp
+
+        sparse.weight._data = jnp.array(dense.weight._data)
+        _loss(dense, ids).backward()
+        _loss(sparse, ids).backward()
+        np.testing.assert_allclose(
+            np.asarray(sparse.weight.grad.to_dense()),
+            np.asarray(dense.weight.grad._data), rtol=1e-6)
+
+    def test_padding_idx_gets_zero_grad(self):
+        emb = nn.Embedding(20, 4, padding_idx=2, sparse=True)
+        _loss(emb, np.array([[2, 5]])).backward()
+        dense = np.asarray(emb.weight.grad.to_dense())
+        np.testing.assert_allclose(dense[2], np.zeros(4))
+        assert np.abs(dense[5]).max() > 0
+
+    def test_accumulation_concats(self):
+        import jax.numpy as jnp
+
+        emb = nn.Embedding(30, 4, sparse=True)
+        _loss(emb, np.array([[1, 2]])).backward()
+        _loss(emb, np.array([[2, 3]])).backward()
+        g = emb.weight.grad
+        assert g.values.shape[0] == 4  # two backward passes, 2 rows each
+        # sums match a dense double-backward
+        dense = nn.Embedding(30, 4, sparse=False)
+        dense.weight._data = jnp.array(emb.weight._data)
+        _loss(dense, np.array([[1, 2]])).backward()
+        _loss(dense, np.array([[2, 3]])).backward()
+        np.testing.assert_allclose(np.asarray(g.to_dense()),
+                                   np.asarray(dense.weight.grad._data),
+                                   rtol=1e-6)
+
+    def test_merged_static_dedupes(self):
+        import jax.numpy as jnp
+
+        sr = SelectedRows(jnp.asarray([5, 2, 5]),
+                          jnp.asarray([[1.0], [2.0], [3.0]]), 10)
+        u_rows, merged = sr.merged_static()
+        got = {int(r): float(v) for r, v in zip(u_rows, merged[:, 0])
+               if int(r) < 10}
+        assert got == {2: 2.0, 5: 4.0}
+
+
+class TestSparseOptimizers:
+    @pytest.mark.parametrize("opt_cls,kw", [
+        (paddle.optimizer.SGD, {}),
+        (paddle.optimizer.Momentum, {"momentum": 0.9}),
+        (paddle.optimizer.Adam, {"lazy_mode": True}),
+        (paddle.optimizer.AdamW, {"weight_decay": 0.0, "lazy_mode": True}),
+    ])
+    def test_sparse_step_matches_dense_on_touched_rows(self, opt_cls, kw):
+        """Touched rows update identically to the dense optimizer; untouched
+        rows (and their moments) stay EXACTLY unchanged (lazy semantics)."""
+        paddle.seed(1)
+        ids = np.array([[3, 7, 3]])
+        d_emb = nn.Embedding(40, 8, sparse=False)
+        s_emb = nn.Embedding(40, 8, sparse=True)
+        import jax.numpy as jnp
+
+        s_emb.weight._data = jnp.array(d_emb.weight._data)  # own buffer:
+        # the dense step DONATES its params; sharing would leave s_emb dead
+        w_before = np.asarray(s_emb.weight._data).copy()
+        d_opt = opt_cls(learning_rate=0.1, parameters=d_emb.parameters(),
+                        **kw)
+        s_opt = opt_cls(learning_rate=0.1, parameters=s_emb.parameters(),
+                        **kw)
+        for _ in range(3):
+            _loss(d_emb, ids).backward()
+            d_opt.step()
+            d_opt.clear_grad()
+            _loss(s_emb, ids).backward()
+            s_opt.step()
+            s_opt.clear_grad()
+        d_w = np.asarray(d_emb.weight._data)
+        s_w = np.asarray(s_emb.weight._data)
+        np.testing.assert_allclose(s_w[[3, 7]], d_w[[3, 7]], rtol=2e-5,
+                                   atol=1e-6)
+        untouched = [i for i in range(40) if i not in (3, 7)]
+        np.testing.assert_array_equal(s_w[untouched], w_before[untouched])
+
+    def test_weight_decay_only_touches_looked_up_rows(self):
+        paddle.seed(2)
+        emb = nn.Embedding(30, 4, sparse=True)
+        w0 = np.asarray(emb.weight._data).copy()
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                     parameters=emb.parameters())
+        _loss(emb, np.array([[5]])).backward()
+        opt.step()
+        w1 = np.asarray(emb.weight._data)
+        assert np.abs(w1[5] - w0[5]).max() > 0
+        untouched = [i for i in range(30) if i != 5]
+        np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+class TestIntegrations:
+    def test_global_norm_clip_includes_sparse(self):
+        """ClipGradByGlobalNorm must count the (merged) sparse grad in the
+        norm and scale it, matching the dense-equivalent clip exactly."""
+        import jax.numpy as jnp
+
+        paddle.seed(4)
+        ids = np.array([[2, 2, 9]])  # duplicates: norm uses MERGED rows
+        d_emb = nn.Embedding(20, 4, sparse=False)
+        s_emb = nn.Embedding(20, 4, sparse=True)
+        s_emb.weight._data = jnp.array(d_emb.weight._data)
+        clip = nn.ClipGradByGlobalNorm(0.01)
+        d_opt = paddle.optimizer.SGD(learning_rate=0.1, grad_clip=clip,
+                                     parameters=d_emb.parameters())
+        s_opt = paddle.optimizer.SGD(learning_rate=0.1, grad_clip=clip,
+                                     parameters=s_emb.parameters())
+        _loss(d_emb, ids).backward()
+        d_opt.step()
+        _loss(s_emb, ids).backward()
+        s_opt.step()
+        np.testing.assert_allclose(np.asarray(s_emb.weight._data),
+                                   np.asarray(d_emb.weight._data),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_grad_scaler_unscales_sparse(self):
+        import jax.numpy as jnp
+
+        paddle.seed(5)
+        emb = nn.Embedding(20, 4, sparse=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=emb.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = _loss(emb, np.array([[3]]))
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        # the applied update must correspond to the UNSCALED gradient
+        emb2 = nn.Embedding(20, 4, sparse=True)
+        paddle.seed(5)
+        emb2 = nn.Embedding(20, 4, sparse=True)
+        # rebuild with same seed gives same init; compare against no-amp run
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=emb2.parameters())
+        _loss(emb2, np.array([[3]])).backward()
+        opt2.step()
+        np.testing.assert_allclose(np.asarray(emb.weight._data),
+                                   np.asarray(emb2.weight._data), rtol=1e-4)
+
+    def test_clear_grad_set_to_zero_and_paddle_grad_densifies(self):
+        emb = nn.Embedding(10, 3, sparse=True)
+        loss = _loss(emb, np.array([[1]]))
+        loss.backward()
+        emb.weight.clear_gradient(True)
+        assert list(emb.weight.grad.shape) == [10, 3]
+        assert float(np.abs(np.asarray(emb.weight.grad._data)).max()) == 0
+        g, = paddle.grad(_loss(emb, np.array([[1]])), [emb.weight])
+        assert isinstance(g, Tensor) and list(g.shape) == [10, 3]
+
+    def test_hook_densifies_cotangent(self):
+        emb = nn.Embedding(10, 3, sparse=True)
+        seen = {}
+        emb.weight.register_hook(lambda g: seen.setdefault(
+            "shape", list(g.shape)))
+        _loss(emb, np.array([[4]])).backward()
+        assert seen["shape"] == [10, 3]  # hook saw the dense gradient
+
+    def test_multi_precision_master_tracks_sparse_updates(self):
+        import jax.numpy as jnp
+
+        paddle.seed(6)
+        emb = nn.Embedding(30, 8, sparse=True)
+        emb.weight._data = emb.weight._data.astype(jnp.bfloat16)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, multi_precision=True,
+                                    parameters=emb.parameters())
+        for _ in range(2):
+            _loss(emb, np.array([[5, 6]])).backward()
+            opt.step()
+            opt.clear_grad()
+        master = opt._master_weights[id(emb.weight)]
+        assert master.dtype == jnp.float32
+        # master and param agree (param is the bf16 cast of the master)
+        np.testing.assert_allclose(
+            np.asarray(master.astype(jnp.bfloat16), np.float32),
+            np.asarray(emb.weight._data, np.float32))
+        # and the master actually moved for the touched rows
+        assert np.abs(np.asarray(master, np.float32)[[5, 6]]).sum() > 0
+
+
+class TestLargeVocab:
+    def test_256k_vocab_no_dense_grad(self):
+        """The VERDICT 'done' bar: 256k-vocab embedding train step with no
+        dense [V, H] gradient materialization — the grad object holds only
+        [n_tokens, H] values and the optimizer touches only those rows."""
+        V, H = 256_000, 64
+        emb = nn.Embedding(V, H, sparse=True)
+        opt = paddle.optimizer.Adam(learning_rate=0.01, lazy_mode=True,
+                                    parameters=emb.parameters())
+        ids = np.random.default_rng(0).integers(0, V, (4, 32))
+        out = emb(Tensor(ids))
+        (out ** 2).sum().backward()
+        g = emb.weight.grad
+        assert getattr(g, "is_selected_rows", False)
+        assert list(g.values.shape) == [128, H]   # 4*32 touched entries
+        # dense would be 256000 x 64; the sparse payload is 2000x smaller
+        assert g.values.size * 8 < V * H / 16
+        opt.step()
+        opt.clear_grad()
+        assert emb.weight.grad is None
+
+    def test_mixed_sparse_dense_model_trains(self):
+        """An Embedding(sparse=True) + Linear model: one optimizer handles
+        both grad kinds in the same step and the loss decreases."""
+        paddle.seed(3)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(500, 16, sparse=True)
+                self.fc = nn.Linear(16, 1)
+
+            def forward(self, ids):
+                return self.fc(self.emb(ids).mean(axis=1))
+
+        m = M()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=m.parameters())
+        ids = np.random.default_rng(1).integers(0, 500, (8, 6))
+        y = np.ones((8, 1), np.float32)
+        first = last = None
+        for _ in range(25):
+            loss = ((m(Tensor(ids)) - Tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            last = float(loss._data)
+            first = last if first is None else first
+        assert last < first * 0.2, (first, last)
