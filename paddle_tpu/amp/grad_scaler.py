@@ -67,15 +67,16 @@ class AmpScaler:
         all_params = [p for p in optimizer._params
                       if isinstance(p, Tensor) and not p.stop_gradient
                       and p.grad is not None]
-        # SelectedRows grads: unscale values in place + finite-check them
-        sparse_inf = False
+        # SelectedRows grads: unscale values in place; finite flags stay on
+        # device and sync ONCE at the end (same design as the dense path)
+        sparse_finite = []
+        inv_sparse = jnp.asarray(1.0 / self._loss_scaling, jnp.float32)
         params = []
         for p in all_params:
             g = p.grad
             if getattr(g, "is_selected_rows", False):
-                inv = jnp.asarray(1.0 / self._loss_scaling, jnp.float32)
-                p._grad = g.scaled(inv)
-                sparse_inf |= not bool(jnp.isfinite(p._grad.values).all())
+                p._grad = g.scaled(inv_sparse)
+                sparse_finite.append(jnp.isfinite(p._grad.values).all())
             else:
                 params.append(p)
         if params:
@@ -94,9 +95,12 @@ class AmpScaler:
             new_grads, found_inf = self._unscale_fn(grads, inv)
             for p, g in zip(params, new_grads):
                 p.grad._data = g
-            self._found_inf = bool(found_inf) or sparse_inf
         else:
-            self._found_inf = sparse_inf
+            found_inf = None
+        # combine dense + sparse flags on device, ONE host sync at the end
+        flags = ([~f for f in sparse_finite]
+                 + ([found_inf] if found_inf is not None else []))
+        self._found_inf = bool(jnp.stack(flags).any()) if flags else False
         self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
 
     unscale_ = _unscale

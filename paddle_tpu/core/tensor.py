@@ -164,6 +164,14 @@ class Tensor:
             raise TypeError("len() of a 0-D tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        # explicit iterator: legacy __getitem__ iteration never terminates
+        # because XLA gathers clamp out-of-range indices instead of raising
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-D tensor")
+        for i in range(self._data.shape[0]):
+            yield self[i]
+
     def __array__(self, dtype=None):
         a = self.numpy()
         return a.astype(dtype) if dtype is not None else a

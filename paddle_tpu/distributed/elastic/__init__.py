@@ -1,0 +1,4 @@
+from .manager import ElasticManager  # noqa: F401
+from .store import MembershipStore  # noqa: F401
+
+__all__ = ["MembershipStore", "ElasticManager"]

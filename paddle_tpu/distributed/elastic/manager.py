@@ -1,0 +1,75 @@
+"""Elastic job manager.
+
+Analog of `fleet/elastic/manager.py` (ElasticManager: membership watch
+:125, fault tolerance :410, scale in/out + rank regeneration :457). The
+launcher registers every healthy worker slot as a pod in the
+MembershipStore; on failure it deregisters the dead pod, waits a
+stabilization window for replacements/joiners, then regenerates the dense
+rank order and reports the new world size. Training resumes from the last
+checkpoint at the new scale (the distributed checkpoint layer reshards on
+load, `distributed/checkpoint/`).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from .store import MembershipStore
+
+__all__ = ["ElasticManager"]
+
+
+class ElasticManager:
+    def __init__(self, store: MembershipStore, min_nodes: int,
+                 max_nodes: int, stabilize_s: float = 1.0):
+        if min_nodes < 1 or max_nodes < min_nodes:
+            raise ValueError(
+                f"invalid elastic range [{min_nodes}, {max_nodes}]")
+        self.store = store
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.stabilize_s = float(stabilize_s)
+
+    # -- membership ---------------------------------------------------------
+    def register(self, pod_id: str, endpoint: str = "") -> None:
+        self.store.register(pod_id, endpoint)
+
+    def heartbeat(self, pod_id: str) -> None:
+        self.store.heartbeat(pod_id)
+
+    def heartbeat_many(self, pod_ids) -> None:
+        self.store.heartbeat_many(pod_ids)
+
+    def report_dead(self, pod_id: str) -> None:
+        """Fault detection input (reference :410 watch): the launcher saw
+        this pod's process die."""
+        self.store.deregister(pod_id)
+
+    def ranks(self) -> List[str]:
+        """Dense rank order over live pods (reference rank regeneration:
+        sorted pod ids -> 0..n-1), capped at max_nodes."""
+        alive = sorted(self.store.alive())
+        return alive[:self.max_nodes]
+
+    # -- scale decisions ----------------------------------------------------
+    def wait_for_world(self, deadline_s: float = 30.0
+                       ) -> Optional[List[str]]:
+        """Block until membership yields a trainable world (>= min_nodes),
+        letting it stabilize so simultaneous joins/leaves coalesce into one
+        restart (reference :457). Returns the rank-ordered pod ids, or
+        None if the deadline passes below min_nodes."""
+        end = time.time() + deadline_s
+        while time.time() < end:
+            pods = self.ranks()
+            if len(pods) >= self.min_nodes:
+                time.sleep(self.stabilize_s)  # coalesce concurrent changes
+                again = self.ranks()
+                if len(again) >= self.min_nodes:
+                    return again
+            time.sleep(0.2)
+        return None
+
+    def scale_changed(self, current: List[str]) -> Tuple[bool, List[str]]:
+        """(changed?, new rank order) vs the running assignment."""
+        now = self.ranks()
+        return now != list(current), now

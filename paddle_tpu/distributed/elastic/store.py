@@ -1,0 +1,88 @@
+"""Elastic membership store.
+
+Analog of the reference's etcd pod registry
+(`fleet/elastic/manager.py:125` — `/paddle/nodes/<job>/<pod>` keys with TTL
+leases). This build has no etcd; the store is a lock-protected JSON file on
+a filesystem every launcher can reach (one host, or a shared mount for
+multi-host). The API mirrors what the manager needs: register with TTL,
+heartbeat, deregister, and an `alive()` snapshot that expires stale pods.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+from typing import Dict
+
+__all__ = ["MembershipStore"]
+
+
+class MembershipStore:
+    def __init__(self, path: str, ttl: float = 10.0):
+        self.path = path
+        self.ttl = float(ttl)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def _locked(self, mutate):
+        """Run `mutate(pods_dict) -> result` under an exclusive file lock."""
+        lock_path = self.path + ".lock"
+        with open(lock_path, "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                try:
+                    with open(self.path) as f:
+                        pods = json.load(f)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    pods = {}
+                result = mutate(pods)
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(pods, f)
+                os.replace(tmp, self.path)
+                return result
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
+
+    def register(self, pod_id: str, endpoint: str = "") -> None:
+        """Announce a pod (reference `_host_to_etcd` registration)."""
+
+        def mutate(pods):
+            pods[pod_id] = {"endpoint": endpoint,
+                            "last_heartbeat": time.time()}
+
+        self._locked(mutate)
+
+    def heartbeat(self, pod_id: str) -> None:
+        self.heartbeat_many([pod_id])
+
+    def heartbeat_many(self, pod_ids) -> None:
+        """Renew several leases under ONE lock/write cycle (the launcher
+        heartbeats every local pod each poll tick)."""
+        now = time.time()
+
+        def mutate(pods):
+            for pid in pod_ids:
+                if pid in pods:
+                    pods[pid]["last_heartbeat"] = now
+
+        self._locked(mutate)
+
+    def deregister(self, pod_id: str) -> None:
+        self._locked(lambda pods: pods.pop(pod_id, None))
+
+    def alive(self) -> Dict[str, dict]:
+        """Live pods; entries past the TTL are expired (lease timeout)."""
+        now = time.time()
+
+        def mutate(pods):
+            dead = [k for k, v in pods.items()
+                    if now - v.get("last_heartbeat", 0) > self.ttl]
+            for k in dead:
+                del pods[k]
+            return dict(pods)
+
+        return self._locked(mutate)
+
+    def clear(self) -> None:
+        self._locked(lambda pods: pods.clear())

@@ -85,26 +85,30 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
         active = [(p, g) for p, g in params_grads
                   if g is not None and getattr(p, "need_clip", True)]
-        sparse_sq = sum(g.sq_sum() for p, g in active
-                        if getattr(g, "is_selected_rows", False))
+        if not active:
+            return params_grads
+        sparse_grads = [g for p, g in active
+                        if getattr(g, "is_selected_rows", False)]
         gs = [g._data for p, g in active
               if not getattr(g, "is_selected_rows", False)]
-        if not gs and not [1 for p, g in active
-                           if getattr(g, "is_selected_rows", False)]:
-            return params_grads
         # Grads may live on disjoint device sets (pipeline stages place each
         # stage's params on its pp coordinate): reduce each grad's square sum
         # where it lives, hop the scalar partials to one device to combine,
         # then hop the scale back to each grad's devices.
-        keys = {self._dev_key(g) for g in gs} or {None}
+        keys = {self._dev_key(g) for g in gs} | \
+            {self._dev_key(g.values) for g in sparse_grads}
         if len(keys) == 1:
             global_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                            for g in gs) + sparse_sq
+                            for g in gs) + sum(g.sq_sum()
+                                               for g in sparse_grads)
         else:
-            home = gs[0].sharding
+            anchor = gs[0] if gs else sparse_grads[0].values
+            home = anchor.sharding
             partials = [jax.device_put(jnp.sum(g.astype(jnp.float32) ** 2),
                                        home) for g in gs]
-            global_sq = sum(partials) + sparse_sq
+            partials += [jax.device_put(g.sq_sum(), home)
+                         for g in sparse_grads]
+            global_sq = sum(partials)
         global_norm = jnp.sqrt(global_sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
@@ -113,7 +117,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 out.append((p, g))
                 continue
             if getattr(g, "is_selected_rows", False):
-                out.append((p, g.scaled(scale)))
+                s = scale if len(keys) == 1 else jax.device_put(
+                    scale, g.values.sharding)
+                out.append((p, g.scaled(s)))
                 continue
             s = scale if len(keys) == 1 else jax.device_put(scale,
                                                             g._data.sharding)

@@ -78,6 +78,11 @@ class _SparseEmbeddingGradNode(autograd_mod.GradNodeBase):
 
         from ...core.selected_rows import SelectedRows
 
+        if self.indices is None:
+            raise RuntimeError(
+                "Trying to backward through node embedding_sparse_grad a "
+                "second time after its buffers were freed; call "
+                "backward(retain_graph=True) the first time.")
         ct = cotangents[0]
         if ct is None:
             return [None]

@@ -205,8 +205,18 @@ class Optimizer:
             return
         # clip first (the clip classes understand SelectedRows), THEN split:
         # SelectedRows gradients take the sparse-apply path (reference
-        # `phi/kernels/selected_rows/` adam/sgd); dense ones the fused step
+        # `phi/kernels/selected_rows/` adam/sgd); dense ones the fused step.
+        # Optimizers with lazy_mode=False (Adam/AdamW default) densify so
+        # untouched rows keep exact dense semantics (moments decay).
         params_grads = self._clip_grads(params_grads)
+        lazy = getattr(self, "_lazy_mode", True)
+        if not lazy:
+            from ..core.tensor import Tensor as _T
+
+            params_grads = [
+                (p, _T(g.to_dense(), stop_gradient=True)
+                 if getattr(g, "is_selected_rows", False) else g)
+                for p, g in params_grads]
         sparse_pairs = [(p, g) for p, g in params_grads
                         if getattr(g, "is_selected_rows", False)]
         params_grads = [(p, g) for p, g in params_grads
@@ -490,6 +500,10 @@ class Adam(Optimizer):
         self._beta1 = float(beta1) if not isinstance(beta1, Tensor) else float(beta1.item())
         self._beta2 = float(beta2) if not isinstance(beta2, Tensor) else float(beta2.item())
         self._epsilon = float(epsilon)
+        # SelectedRows grads: lazy_mode=True updates only touched rows
+        # (reference sparse adam lazy path); False keeps exact dense Adam
+        # semantics by densifying the gradient (untouched moments decay).
+        self._lazy_mode = bool(lazy_mode)
 
     def _update_one(self, p, g, accs, lr, wd):
         import jax.numpy as jnp
@@ -517,7 +531,7 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip,
+                         weight_decay, grad_clip, lazy_mode=lazy_mode,
                          multi_precision=multi_precision, name=name, **kw)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio

@@ -1,0 +1,167 @@
+"""Elastic membership + scale up/down (round-3 VERDICT item 9; reference
+`fleet/elastic/manager.py:125,410,457`).
+
+Unit tests cover the membership store + manager; the integration test runs
+the full 2 -> 1 -> 2 cycle through the launch CLI: a worker is killed
+(scale-in to the survivors), a new pod registers in the store (watch-
+triggered scale-out restart), and the job finishes at world size 2.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.elastic import ElasticManager, MembershipStore
+
+
+class TestMembershipStore:
+    def test_register_heartbeat_expire(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=0.5)
+        st.register("a", "h:1")
+        st.register("b", "h:2")
+        assert sorted(st.alive()) == ["a", "b"]
+        time.sleep(0.3)
+        st.heartbeat("a")
+        time.sleep(0.35)  # b's lease lapsed, a's renewed
+        assert sorted(st.alive()) == ["a"]
+        st.deregister("a")
+        assert st.alive() == {}
+
+    def test_concurrent_registration(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+
+        def reg(i):
+            MembershipStore(str(tmp_path / "m.json"), ttl=30).register(
+                f"pod{i}")
+
+        threads = [threading.Thread(target=reg, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(st.alive()) == 16  # no lost updates under the file lock
+
+
+class TestElasticManager:
+    def test_rank_regeneration_and_bounds(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        mgr = ElasticManager(st, min_nodes=1, max_nodes=3)
+        for pid in ("h:slot2", "h:slot0", "h:slot1", "h:slot3"):
+            mgr.register(pid)
+        # dense rank order is sorted, capped at max_nodes
+        assert mgr.ranks() == ["h:slot0", "h:slot1", "h:slot2"]
+        mgr.report_dead("h:slot1")
+        assert mgr.ranks() == ["h:slot0", "h:slot2", "h:slot3"]
+        changed, now = mgr.scale_changed(["h:slot0", "h:slot1", "h:slot2"])
+        assert changed and len(now) == 3
+
+    def test_wait_for_world_blocks_until_min(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        mgr = ElasticManager(st, min_nodes=2, max_nodes=4, stabilize_s=0.05)
+        assert mgr.wait_for_world(deadline_s=0.5) is None  # empty store
+        mgr.register("a")
+        t = threading.Thread(target=lambda: (time.sleep(0.3),
+                                             mgr.register("b")))
+        t.start()
+        pods = mgr.wait_for_world(deadline_s=5.0)
+        t.join()
+        assert pods == ["a", "b"]
+
+    def test_invalid_range(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"))
+        with pytest.raises(ValueError):
+            ElasticManager(st, min_nodes=3, max_nodes=2)
+
+
+_ELASTIC_WORKER = '''
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank, world = dist.get_rank(), dist.get_world_size()
+print(f"ROUND world={world} rank={rank}", flush=True)
+flag = os.environ["ELASTIC_TEST_FLAG"]
+if world == 2 and rank == 1 and not os.path.exists(flag):
+    open(flag, "w").write("died-once")
+    print("SIMULATED_FAILURE", flush=True)
+    os._exit(17)          # hard fault -> scale-in to the survivor
+if world == 1:
+    # keep training at the reduced scale until the controller adopts the
+    # joiner and restarts us (SIGTERM) -- or give up after 25s
+    print("TRAINING_AT_WORLD_1", flush=True)
+    time.sleep(25)
+    sys.exit(0)
+print(f"FINISHED world={world} rank={rank}", flush=True)
+'''
+
+
+@pytest.mark.timeout(300)
+def test_kill_worker_scale_down_then_up(tmp_path):
+    """2 workers -> rank1 dies -> job continues at world 1 -> a new pod
+    registers -> controller restarts at world 2 -> success."""
+    script = tmp_path / "worker.py"
+    script.write_text(_ELASTIC_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    store_path = str(tmp_path / "elastic.json")
+    flag = str(tmp_path / "died.flag")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TEST_FLAG"] = flag
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1:2", "--nproc_per_node", "2",
+         "--elastic_store", store_path, "--elastic_timeout", "10",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, cwd=repo, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+    # wait for the scale-down round (world_size=1) to start, then register
+    # a joiner pod to trigger the scale-out restart
+    joined = False
+    deadline = time.time() + 180
+    out_lines = []
+
+    def reader():
+        for line in proc.stdout:
+            out_lines.append(line)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    def _world1_training_started():
+        logdir = tmp_path / "log"
+        if not logdir.exists():
+            return False
+        return any("TRAINING_AT_WORLD_1" in f.read_text()
+                   for f in logdir.iterdir() if f.is_file())
+
+    while time.time() < deadline and proc.poll() is None:
+        # join only once the reduced-world round is genuinely training, so
+        # the scale-out restart demonstrably interrupts live work
+        if not joined and _world1_training_started():
+            MembershipStore(store_path, ttl=60).register("127.0.0.1:joiner")
+            joined = True
+        time.sleep(0.3)
+    code = proc.wait(timeout=60)
+    t.join(timeout=5)
+    logs = "".join(out_lines)
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for f in logdir.iterdir():
+            if f.is_file():
+                logs += f.read_text()
+    assert joined, f"never saw the world_size=1 round:\n{logs}"
+    assert code == 0, f"elastic job failed (exit {code}):\n{logs}"
+    assert "SIMULATED_FAILURE" in logs
+    assert "TRAINING_AT_WORLD_1" in logs          # scale-in really ran
+    assert "membership grew" in logs              # watch-triggered scale-out
+    assert "FINISHED world=2 rank=0" in logs      # recovered at full scale
+    assert "FINISHED world=2 rank=1" in logs

@@ -112,11 +112,37 @@ class TestSparseOptimizers:
         untouched = [i for i in range(40) if i not in (3, 7)]
         np.testing.assert_array_equal(s_w[untouched], w_before[untouched])
 
+    def test_non_lazy_adam_matches_dense_everywhere(self):
+        """Adam(lazy_mode=False) (the default) must keep EXACT dense Adam
+        semantics — untouched rows' moments decay — by densifying."""
+        import jax.numpy as jnp
+
+        paddle.seed(7)
+        ids_a, ids_b = np.array([[3]]), np.array([[8]])
+        d_emb = nn.Embedding(12, 4, sparse=False)
+        s_emb = nn.Embedding(12, 4, sparse=True)
+        s_emb.weight._data = jnp.array(d_emb.weight._data)
+        d_opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                      parameters=d_emb.parameters())
+        s_opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                      parameters=s_emb.parameters())
+        for ids in (ids_a, ids_b, ids_a):  # row 3 untouched at step 2
+            _loss(d_emb, ids).backward()
+            d_opt.step()
+            d_opt.clear_grad()
+            _loss(s_emb, ids).backward()
+            s_opt.step()
+            s_opt.clear_grad()
+        np.testing.assert_allclose(np.asarray(s_emb.weight._data),
+                                   np.asarray(d_emb.weight._data),
+                                   rtol=1e-5, atol=1e-7)
+
     def test_weight_decay_only_touches_looked_up_rows(self):
         paddle.seed(2)
         emb = nn.Embedding(30, 4, sparse=True)
         w0 = np.asarray(emb.weight._data).copy()
         opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                     lazy_mode=True,
                                      parameters=emb.parameters())
         _loss(emb, np.array([[5]])).backward()
         opt.step()
@@ -199,6 +225,7 @@ class TestIntegrations:
         emb = nn.Embedding(30, 8, sparse=True)
         emb.weight._data = emb.weight._data.astype(jnp.bfloat16)
         opt = paddle.optimizer.Adam(learning_rate=0.1, multi_precision=True,
+                                    lazy_mode=True,
                                     parameters=emb.parameters())
         for _ in range(2):
             _loss(emb, np.array([[5, 6]])).backward()

@@ -1,0 +1,212 @@
+"""Generate OPS_PARITY.json — the machine-readable parity manifest
+(round-3 VERDICT item 6; plays the tracking role of the reference's
+`phi/ops/yaml/ops.yaml`, not its format).
+
+For every reference export list (parsed from /root/reference via AST — the
+reference package itself is not importable here) the generator records per
+symbol:
+  implemented   — resolves on the paddle_tpu namespace
+  tested        — the symbol is exercised somewhere under tests/
+  vjp_verified  — an automated sweep called the op on canonical float
+                  inputs and backward() produced a finite gradient
+                  (false = not covered by the sweep, NOT known-broken)
+
+`tests/test_ops_parity.py` replays the `implemented` claims against the
+live package and fails on any regression, keeping the manifest honest
+across rounds.
+
+Usage: python tools/gen_ops_parity.py   (run from the repo root)
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference/python/paddle"
+
+NAMESPACES = [
+    # (manifest key, reference file, list name, our attr path)
+    ("paddle", f"{REF}/__init__.py", "__all__", ""),
+    ("paddle.nn", f"{REF}/nn/__init__.py", "__all__", "nn"),
+    ("paddle.nn.functional", f"{REF}/nn/functional/__init__.py", "__all__",
+     "nn.functional"),
+    ("paddle.linalg", f"{REF}/linalg.py", "__all__", "linalg"),
+    ("paddle.fft", f"{REF}/fft.py", "__all__", "fft"),
+    ("paddle.sparse", f"{REF}/sparse/__init__.py", "__all__", "sparse"),
+    ("paddle.distribution", f"{REF}/distribution/__init__.py", "__all__",
+     "distribution"),
+    ("paddle.signal", f"{REF}/signal.py", "__all__", "signal"),
+    ("paddle.geometric", f"{REF}/geometric/__init__.py", "__all__",
+     "geometric"),
+    ("Tensor", f"{REF}/tensor/__init__.py", "tensor_method_func",
+     "__tensor__"),
+]
+
+
+def parse_exports(path: str, list_name: str):
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == list_name:
+                    return sorted(set(ast.literal_eval(node.value)))
+    raise RuntimeError(f"{list_name} not found in {path}")
+
+
+def resolve(paddle, attr_path: str, name: str):
+    if attr_path == "__tensor__":
+        obj = paddle.Tensor
+    else:
+        obj = paddle
+        for part in [p for p in attr_path.split(".") if p]:
+            obj = getattr(obj, part, None)
+            if obj is None:
+                return None
+    return getattr(obj, name, None)
+
+
+def scan_tested(names):
+    """Symbols appearing as `.name(` / `.name)` / `.name,` / `.name ` in any
+    test file — cheap but effective evidence the surface is exercised."""
+    blob = ""
+    tests_dir = os.path.join(REPO, "tests")
+    for fn in os.listdir(tests_dir):
+        if fn.endswith(".py"):
+            blob += open(os.path.join(tests_dir, fn)).read()
+    hits = set()
+    for name in names:
+        if re.search(rf"\.{re.escape(name)}\b", blob):
+            hits.add(name)
+    return hits
+
+
+def vjp_sweep(paddle, exports_by_ns):
+    """Try f(x[, y]) on canonical positive float inputs; on success, run
+    backward and check the input grad is finite. Returns the set of
+    '<ns>:<name>' that passed. Runs under jax.disable_jit(): the sweep
+    checks vjp NUMERICS per op, and skipping 600 XLA compiles keeps it
+    under a minute."""
+    import signal
+
+    import jax
+    import numpy as np
+
+    class _OpTimeout(Exception):
+        pass
+
+    def _alarm(_sig, _frm):
+        raise _OpTimeout()
+
+    signal.signal(signal.SIGALRM, _alarm)
+
+    import time
+
+    budget_s = float(os.environ.get("OPS_PARITY_SWEEP_BUDGET", "300"))
+    t_end = time.time() + budget_s
+    ok = set()
+    swept = set()
+    ctx = jax.disable_jit()
+    ctx.__enter__()
+    for ns_key, attr_path, names in exports_by_ns:
+        if ns_key not in ("paddle", "paddle.nn.functional", "paddle.linalg",
+                          "paddle.signal"):
+            continue
+        for name in names:
+            if time.time() > t_end:  # time-boxed: unswept ops stay false
+                break
+            fn = resolve(paddle, attr_path, name)
+            if fn is None or not callable(fn) or isinstance(fn, type):
+                continue
+            swept.add(f"{ns_key}:{name}")
+            if os.environ.get("OPS_PARITY_VERBOSE"):
+                print(f"[sweep] {ns_key}:{name}", flush=True)
+            for arity in (1, 2):
+                try:
+                    signal.alarm(3)  # per-attempt budget: skip stragglers
+                    xs = []
+                    for _ in range(arity):
+                        t = paddle.Tensor(
+                            np.asarray([[0.6, 0.3], [0.2, 0.4]],
+                                       np.float32))
+                        t.stop_gradient = False
+                        xs.append(t)
+                    out = fn(*xs)
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    f = [o for o in outs
+                         if isinstance(o, paddle.Tensor)
+                         and str(o._data.dtype).startswith(("float",
+                                                            "bfloat"))]
+                    if not f:
+                        break
+                    f[0].sum().backward()
+                    g = xs[0].grad
+                    if g is not None and bool(
+                            np.isfinite(np.asarray(g._data)).all()):
+                        ok.add(f"{ns_key}:{name}")
+                    break
+                except (_OpTimeout, Exception):
+                    continue
+                finally:
+                    signal.alarm(0)
+    ctx.__exit__(None, None, None)
+    signal.alarm(0)
+    print(f"[sweep] {len(ok)}/{len(swept)} callable exports vjp-verified "
+          f"within the {budget_s:.0f}s budget", flush=True)
+    return ok
+
+
+def main():
+    sys.path.insert(0, REPO)
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force: outer env may point at TPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    manifest = {"note": "generated by tools/gen_ops_parity.py; "
+                        "tests/test_ops_parity.py enforces no regression",
+                "namespaces": {}}
+    exports_by_ns = []
+    for ns_key, ref_file, list_name, attr_path in NAMESPACES:
+        names = parse_exports(ref_file, list_name)
+        exports_by_ns.append((ns_key, attr_path, names))
+    vjp_ok = vjp_sweep(paddle, exports_by_ns)
+
+    for ns_key, attr_path, names in exports_by_ns:
+        tested = scan_tested(names)
+        entries = {}
+        n_impl = 0
+        for name in names:
+            impl = resolve(paddle, attr_path, name) is not None
+            n_impl += bool(impl)
+            entries[name] = {
+                "implemented": impl,
+                "tested": name in tested,
+                "vjp_verified": f"{ns_key}:{name}" in vjp_ok,
+            }
+        manifest["namespaces"][ns_key] = {
+            "attr_path": attr_path,
+            "total": len(names),
+            "implemented": n_impl,
+            "tested": sum(1 for e in entries.values() if e["tested"]),
+            "vjp_verified": sum(1 for e in entries.values()
+                                if e["vjp_verified"]),
+            "exports": entries,
+        }
+        print(f"{ns_key}: {n_impl}/{len(names)} implemented, "
+              f"{manifest['namespaces'][ns_key]['tested']} tested, "
+              f"{manifest['namespaces'][ns_key]['vjp_verified']} "
+              "vjp-verified")
+
+    out = os.path.join(REPO, "OPS_PARITY.json")
+    with open(out, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
