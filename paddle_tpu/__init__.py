@@ -89,6 +89,33 @@ from .utils.flops import flops  # noqa: E402
 get_cuda_rng_state = get_rng_state
 set_cuda_rng_state = set_rng_state
 
+# Tensor-method parity: bind every reference tensor_method_func name that
+# is not yet a Tensor attribute to the same-named free function (reference
+# `tensor/__init__.py` does the identical setattr loop).
+from .tensor_method_names import TENSOR_METHOD_NAMES as _TM_NAMES  # noqa: E402
+
+
+def _bind_tensor_methods():
+    import sys as _sys
+
+    me = _sys.modules[__name__]
+    search = [me]
+    for sub in ("linalg", "fft", "signal", "geometric"):
+        m = getattr(me, sub, None)
+        if m is not None:
+            search.append(m)
+    for name in _TM_NAMES:
+        if hasattr(Tensor, name):
+            continue
+        for mod in search:
+            fn = getattr(mod, name, None)
+            if callable(fn) and not isinstance(fn, type):
+                setattr(Tensor, name, fn)
+                break
+
+
+_bind_tensor_methods()
+
 
 class LazyGuard:
     """Compatibility context (reference nn/initializer/lazy_init.py): defers

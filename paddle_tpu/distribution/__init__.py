@@ -7,6 +7,8 @@ from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
                        Multinomial, Poisson)
 from .distribution import Distribution
 from .kl import kl_divergence, register_kl
+from .multivariate import (ContinuousBernoulli, ExponentialFamily,
+                           LKJCholesky, MultivariateNormal)
 from .transform import (AbsTransform, AffineTransform, ChainTransform,
                         ExpTransform, Independent, PowerTransform,
                         SigmoidTransform, SoftmaxTransform,
@@ -21,5 +23,6 @@ __all__ = [
     "AffineTransform", "ExpTransform", "PowerTransform", "AbsTransform",
     "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
     "StickBreakingTransform", "ChainTransform", "TransformedDistribution",
-    "Independent",
+    "Independent", "MultivariateNormal", "ContinuousBernoulli",
+    "LKJCholesky", "ExponentialFamily",
 ]

@@ -1,0 +1,326 @@
+"""Round-4 distribution parity additions (reference
+`python/paddle/distribution/`): MultivariateNormal, ContinuousBernoulli,
+LKJCholesky, ExponentialFamily.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _arr
+
+__all__ = ["MultivariateNormal", "ContinuousBernoulli", "LKJCholesky",
+           "ExponentialFamily"]
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    `distribution/exponential_family.py`): subclasses expose natural
+    parameters + log-normalizer; `entropy` falls out via the Bregman
+    identity (autodiff of the log-normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        """-E[log p] from the log-normalizer gradient (reference
+        exponential_family.py:entropy, Bregman identity) — ELEMENTWISE:
+        batched natural params give batch-shaped entropy. The grad of the
+        summed log-normalizer is elementwise because A(.) acts per
+        element."""
+        import jax
+        import jax.numpy as jnp
+
+        nparams = [jnp.asarray(p, jnp.float32)
+                   for p in self._natural_parameters]
+        lg_elem = self._log_normalizer(*nparams)
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(
+            tuple(nparams))
+        ent = lg_elem - sum(p * g for p, g in zip(nparams, grads))
+        return Tensor(ent + self._mean_carrier_measure)
+
+
+class MultivariateNormal(Distribution):
+    """N(loc, Sigma) (reference `distribution/multivariate_normal.py`):
+    parameterized by any one of covariance/precision/scale_tril; all math
+    runs on the Cholesky factor (triangular solves, no inverses)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        import jax.numpy as jnp
+
+        given = [a is not None for a in (covariance_matrix,
+                                         precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be given")
+        self.loc = jnp.asarray(_arr(loc), jnp.float32)
+        if scale_tril is not None:
+            self._scale_tril = jnp.asarray(_arr(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            cov = jnp.asarray(_arr(covariance_matrix), jnp.float32)
+            self._scale_tril = jnp.linalg.cholesky(cov)
+        else:
+            prec = jnp.asarray(_arr(precision_matrix), jnp.float32)
+            chol_p = jnp.linalg.cholesky(prec)
+            eye = jnp.eye(prec.shape[-1], dtype=jnp.float32)
+            # Sigma = P^-1 -> L = (chol(P)^-T) lower-triangularized via solve
+            inv = jnp.linalg.solve(prec, eye)
+            self._scale_tril = jnp.linalg.cholesky(inv)
+        d = self.loc.shape[-1]
+        super().__init__(batch_shape=tuple(np.broadcast_shapes(
+            self.loc.shape[:-1], self._scale_tril.shape[:-2])),
+            event_shape=(d,))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def scale_tril(self):
+        return Tensor(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        import jax.numpy as jnp
+
+        return Tensor(self._scale_tril
+                      @ jnp.swapaxes(self._scale_tril, -1, -2))
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.sum(self._scale_tril ** 2, axis=-1))
+
+    def rsample(self, shape=(), key=None):
+        import jax
+        import jax.numpy as jnp
+
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(self._key(key), shp, jnp.float32)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._scale_tril, eps))
+
+    def sample(self, shape=(), key=None):
+        return self.rsample(shape, key)
+
+    def log_prob(self, value):
+        import jax
+        import jax.numpy as jnp
+
+        v = jnp.asarray(_arr(value), jnp.float32)
+        d = self.event_shape[0]
+        diff = v - self.loc
+        z = jax.scipy.linalg.solve_triangular(
+            self._scale_tril, diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        return Tensor(-0.5 * jnp.sum(z * z, axis=-1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        ent = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(ent, self.batch_shape))
+
+    def kl_divergence(self, other):
+        import jax
+        import jax.numpy as jnp
+
+        d = self.event_shape[0]
+        lo, ls = other._scale_tril, self._scale_tril
+        m = jax.scipy.linalg.solve_triangular(lo, ls, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        diff = other.loc - self.loc
+        z = jax.scipy.linalg.solve_triangular(
+            lo, diff[..., None], lower=True)[..., 0]
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(lo, axis1=-2, axis2=-1)),
+                          axis=-1)
+                  - jnp.sum(jnp.log(jnp.diagonal(ls, axis1=-2, axis2=-1)),
+                            axis=-1))
+        return Tensor(0.5 * (tr + jnp.sum(z * z, axis=-1) - d) + logdet)
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """CB(probs) on [0, 1] (reference
+    `distribution/continuous_bernoulli.py`; Loaiza-Ganem & Cunningham
+    2019): the [0,1]-supported relaxation with the log-normalizing
+    constant C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        import jax.numpy as jnp
+
+        self.probs = jnp.clip(jnp.asarray(_arr(probs), jnp.float32),
+                              1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    def _outside_lims(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_c(self):
+        """log C(p), Taylor-stabilized near p=0.5."""
+        import jax.numpy as jnp
+
+        p = self.probs
+        safe = jnp.where(self._outside_lims(), p, 0.4)
+        exact = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * safe))
+                        / jnp.abs(1 - 2 * safe))
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3 + 104.0 / 45 * x * x) * x * x
+        return jnp.where(self._outside_lims(), exact, taylor)
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        p = self.probs
+        safe = jnp.where(self._outside_lims(), p, 0.4)
+        exact = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3 + 16.0 / 45 * x * x) * x
+        return Tensor(jnp.where(self._outside_lims(), exact, taylor))
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        p = self.probs
+        safe = jnp.where(self._outside_lims(), p, 0.4)
+        exact = safe * (safe - 1) / (1 - 2 * safe) ** 2 + \
+            1 / (2 * jnp.arctanh(1 - 2 * safe)) ** 2
+        x = p - 0.5
+        taylor = 1.0 / 12 - (1.0 / 15 - 128.0 / 945 * x * x) * x * x
+        return Tensor(jnp.where(self._outside_lims(), exact, taylor))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        v = jnp.asarray(_arr(value), jnp.float32)
+        p = self.probs
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_c())
+
+    def rsample(self, shape=(), key=None):
+        """Inverse-CDF sampling (reparameterized; reference icdf)."""
+        import jax
+        import jax.numpy as jnp
+
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(key), shp, jnp.float32, 1e-6,
+                               1 - 1e-6)
+        p = self.probs
+        safe = jnp.where(self._outside_lims(), p, 0.4)
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(self._outside_lims(), icdf, u))
+
+    def sample(self, shape=(), key=None):
+        return self.rsample(shape, key)
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        p = self.probs
+        mean = self.mean._data
+        return Tensor(-(mean * jnp.log(p) + (1 - mean) * jnp.log1p(-p)
+                        + self._log_c()))
+
+    @property
+    def _natural_parameters(self):
+        import jax.numpy as jnp
+
+        return (jnp.log(self.probs / (1 - self.probs)),)
+
+    def _log_normalizer(self, eta):
+        import jax.numpy as jnp
+
+        safe = jnp.abs(eta) > 1e-3
+        e = jnp.where(safe, eta, 1.0)
+        exact = jnp.log(jnp.abs(jnp.expm1(e)) / jnp.abs(e))
+        return jnp.where(safe, exact, eta / 2 + eta * eta / 24)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference
+    `distribution/lkj_cholesky.py`; onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        import jax.numpy as jnp
+
+        if dim < 2:
+            raise ValueError(f"dim must be >= 2, got {dim}")
+        self.dim = int(dim)
+        self.concentration = jnp.asarray(_arr(concentration), jnp.float32)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=tuple(self.concentration.shape),
+                         event_shape=(dim, dim))
+
+    def sample(self, shape=(), key=None):
+        """Onion method: rows built from beta-distributed radii and
+        uniformly distributed directions."""
+        import jax
+        import jax.numpy as jnp
+
+        key = self._key(key)
+        d = self.dim
+        shp = tuple(shape) + self.batch_shape
+        eta = jnp.broadcast_to(self.concentration, shp)
+        k1, k2 = jax.random.split(key)
+        # partial correlations ~ Beta(a_i, b_i) mapped to [-1, 1] (cvine)
+        out = jnp.zeros(shp + (d, d)).at[..., 0, 0].set(1.0)
+        beta0 = eta + (d - 2) / 2.0
+        keys = jax.random.split(k2, d - 1)
+        for i in range(1, d):
+            b = beta0 - (i - 1) / 2.0
+            # row direction on the sphere
+            ku, kb = jax.random.split(keys[i - 1])
+            u = jax.random.normal(ku, shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            y = jax.random.beta(kb, i / 2.0, b, shp)   # squared radius
+            r = jnp.sqrt(y)
+            row = r[..., None] * u
+            diag = jnp.sqrt(jnp.clip(1.0 - y, 1e-12))
+            out = out.at[..., i, :i].set(row)
+            out = out.at[..., i, i].set(diag)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        """Density of the Cholesky factor (reference lkj_cholesky.py
+        log_prob: diag-power kernel + mvlgamma normalizer)."""
+        import jax.numpy as jnp
+        from jax.scipy.special import gammaln
+
+        L = jnp.asarray(_arr(value), jnp.float32)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        powers = 2 * (eta[..., None] - 1) + d - order
+        unnorm = jnp.sum(powers * jnp.log(diag), axis=-1)
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+        # mvlgamma(alpha - 0.5, dm1)
+        i = jnp.arange(1, dm1 + 1, dtype=jnp.float32)
+        mvlg = (dm1 * (dm1 - 1) / 4.0) * math.log(math.pi) + jnp.sum(
+            gammaln(alpha[..., None] - 0.5 + (1 - i) / 2.0), axis=-1)
+        normalize = 0.5 * dm1 * math.log(math.pi) + mvlg - dm1 * gammaln(
+            alpha)
+        return Tensor(unnorm - normalize)

@@ -32,3 +32,16 @@ from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # no
                    triplet_margin_loss)
 from .attention import (flash_attention, flash_attn_unpadded,  # noqa: F401
                         scaled_dot_product_attention, sdp_kernel)
+from .loss import (adaptive_log_softmax_with_loss, dice_loss,  # noqa: F401
+                   hsigmoid_loss, margin_cross_entropy, multi_margin_loss,
+                   npair_loss, rnnt_loss,
+                   triplet_margin_with_distance_loss)
+from .attention import (flash_attn_qkvpacked,  # noqa: F401
+                        flash_attn_varlen_qkvpacked, flashmask_attention,
+                        sparse_attention)
+from .extended import (affine_grid, elu_, feature_alpha_dropout,  # noqa: F401
+                       fractional_max_pool2d, fractional_max_pool3d,
+                       gather_tree, grid_sample, gumbel_softmax, hardtanh_,
+                       leaky_relu_, max_unpool1d, max_unpool3d,
+                       pairwise_distance, relu_, sequence_mask, softmax_,
+                       tanh_, temporal_shift, thresholded_relu_, zeropad2d)

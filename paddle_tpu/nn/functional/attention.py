@@ -197,3 +197,123 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
         out = common.dropout(out, p=dropout, training=training)
     return out, None
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Packed-QKV flash attention (reference
+    `nn/functional/flash_attention.py:flash_attn_qkvpacked`):
+    qkv [B, S, 3, H, D] -> unpack -> the flash path."""
+    from ...ops._helpers import as_tensor
+    from ...ops.manipulation import squeeze, split
+
+    qkv = as_tensor(qkv)
+    q, k, v = (squeeze(t, 2) for t in split(qkv, 3, axis=2))
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """Packed variable-length variant (reference
+    flash_attention.py:flash_attn_varlen_qkvpacked): [T, 3, H, D] +
+    cu_seqlens -> the unpadded flash path."""
+    from ...ops._helpers import as_tensor
+    from ...ops.manipulation import squeeze, split
+
+    qkv = as_tensor(qkv)
+    q, k, v = (squeeze(t, 1) for t in split(qkv, 3, axis=1))
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        name=None):
+    """FlashMask attention (reference
+    flash_attention.py:flashmask_attention): the column-wise sparse mask
+    representation [B, H|1, S, 1|2|4] is expanded to a dense bool mask and
+    fed to the SDPA composite (Pallas flash path when mask-free/causal).
+    The O(S) mask representation is honored at the API level; kernel-level
+    mask skipping is a future Pallas specialization."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor as _T
+    from ...ops._helpers import as_tensor
+
+    query = as_tensor(query)
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal,
+                                            dropout_p=dropout)
+    idx = as_tensor(startend_row_indices)._data  # [B, H', S, 1|2|4]
+    b, hp, s, nidx = idx.shape
+    rows = jnp.arange(s)[:, None]                # attending row
+    cols = jnp.arange(s)[None, :]
+    def band(lo_col, hi_col):
+        start = idx[..., lo_col][:, :, None, :]        # [B, H', 1, S]
+        m = rows[None, None] >= start
+        if hi_col is not None and nidx > hi_col:
+            m &= rows[None, None] < idx[..., hi_col][:, :, None, :]
+        return m
+
+    if causal:
+        base = rows >= cols
+        # LTS: start row per column -> mask rows in [start, end)
+        masked = band(0, 1 if nidx >= 2 else None)
+        allow = base[None, None] & ~masked
+    else:
+        # full attention with [start0,end0,start1,end1] bands masked out
+        masked = band(0, 1 if nidx >= 2 else None)
+        if nidx >= 4:
+            masked |= band(2, 3)
+        allow = jnp.ones((1, 1, s, s), bool) & ~masked
+    mask = _T(allow, stop_gradient=True)
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        dropout_p=dropout)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-CSR sparse attention (reference
+    `nn/functional/sparse_attention.py`): the CSR pattern (offset/columns
+    per head row) is expanded to a dense bool mask for the SDPA composite.
+    Honest fallback: compute is dense under XLA; the CSR API contract and
+    numerics match, kernel-level skipping is a future Pallas path."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor as _T
+    from ...ops._helpers import as_tensor
+
+    query = as_tensor(query)
+    off = as_tensor(sparse_csr_offset)._data      # [B, H, S+1]
+    cols = as_tensor(sparse_csr_columns)._data    # [B, H, nnz]
+    b, h, s, d = query._data.shape
+    nnz = cols.shape[-1]
+    # expand CSR -> dense allow mask: entry e belongs to row r iff
+    # off[r] <= e < off[r+1]
+    e = jnp.arange(nnz)
+    row_idx = (e[None, None, None, :] >= off[..., :-1, None]) & \
+        (e[None, None, None, :] < off[..., 1:, None])  # [B,H,S,nnz]
+    rows_for_e = jnp.argmax(row_idx, axis=2)       # [B, H, nnz]
+    allow = jnp.zeros((b, h, s, s), bool).at[
+        jnp.arange(b)[:, None, None], jnp.arange(h)[None, :, None],
+        rows_for_e, cols.astype(jnp.int32)].set(True)
+    mask = _T(allow, stop_gradient=True)
+    # reference layout is [B, H, S, D]; the sdpa composite takes [B, S, H, D]
+    from ...ops.manipulation import transpose as _tp
+
+    key = as_tensor(key)
+    value = as_tensor(value)
+    out = scaled_dot_product_attention(
+        _tp(query, [0, 2, 1, 3]), _tp(key, [0, 2, 1, 3]),
+        _tp(value, [0, 2, 1, 3]), attn_mask=mask)
+    return _tp(out, [0, 2, 1, 3])

@@ -1,0 +1,459 @@
+"""Round-4 functional parity additions (OPS_PARITY gap list).
+
+Reference analogs live across `python/paddle/nn/functional/`: vision.py
+(affine_grid, grid_sample, pixel ops), pooling.py (max_unpool1d/3d,
+fractional pools), common.py (pairwise_distance, zeropad2d, sequence_mask,
+gather_tree, feature_alpha_dropout), activation.py (gumbel_softmax,
+inplace variants), input.py. TPU-first notes inline per op.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ...framework import random as random_mod
+from ...ops._helpers import as_tensor
+
+__all__ = [
+    "affine_grid", "grid_sample", "temporal_shift", "zeropad2d",
+    "sequence_mask", "gather_tree", "gumbel_softmax", "pairwise_distance",
+    "feature_alpha_dropout", "max_unpool1d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+    "relu_", "elu_", "leaky_relu_", "hardtanh_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+def _reg(name, fn, multi_out=False):
+    if name not in dispatch.op_registry():
+        dispatch.register_op(name, fn, multi_out=multi_out)
+
+
+# -- inplace activation variants (x is rebound, tape-safe like ops._INPLACE)
+
+
+def _inplace(base):
+    from ...ops._helpers import inplace_rebind
+
+    def op(x, *args, **kwargs):
+        return inplace_rebind(x, base(x, *args, **kwargs))
+
+    op.__name__ = base.__name__ + "_"
+    return op
+
+
+def _bind_inplace_activations():
+    from ...ops import activation as A
+    from ...ops import math as M
+
+    g = globals()
+    for name in ("relu", "elu", "leaky_relu", "hardtanh", "softmax", "tanh",
+                 "thresholded_relu"):
+        base = getattr(A, name, None) or getattr(M, name)
+        g[name + "_"] = _inplace(base)
+
+
+# -- spatial ----------------------------------------------------------------
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from batched 2x3 affine matrices
+    (reference `nn/functional/vision.py:affine_grid`)."""
+    theta = as_tensor(theta)
+    out_shape = [int(s) for s in out_shape]
+
+    def impl(theta, *, sizes, align):
+        import jax.numpy as jnp
+
+        n, c, h, w = sizes
+
+        def axis_coords(m):
+            if align:
+                return jnp.linspace(-1.0, 1.0, m)
+            step = 2.0 / m
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, m)
+
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)                    # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        out = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+        return out                                       # [N, H, W, 2]
+
+    _reg("affine_grid", impl)
+    return dispatch.apply("affine_grid", [theta],
+                          {"sizes": tuple(out_shape),
+                           "align": bool(align_corners)})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling of NCHW features at normalized grid
+    locations (reference vision.py:grid_sample). Gather-based: XLA turns
+    the 4 corner gathers + lerp into one fused kernel."""
+    x, grid = as_tensor(x), as_tensor(grid)
+
+    def impl(x, grid, *, mode, padding_mode, align):
+        import jax.numpy as jnp
+
+        n, c, h, w = x.shape
+        gx = grid[..., 0]
+        gy = grid[..., 1]
+
+        def unnorm(g, size):
+            if align:
+                return (g + 1) * (size - 1) / 2.0
+            return ((g + 1) * size - 1) / 2.0
+
+        fx = unnorm(gx, w)
+        fy = unnorm(gy, h)
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
+        elif padding_mode == "reflection":
+            def reflect(f, size):
+                if align:
+                    span = 2 * (size - 1)
+                    f = jnp.abs(f) % span
+                    return jnp.where(f > size - 1, span - f, f)
+                span = 2 * size
+                f = (f + 0.5) % span
+                f = jnp.where(f > size, span - f, f) - 0.5
+                return jnp.clip(f, 0, size - 1)
+
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+
+        def gather(ix, iy):
+            inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                      & (iy <= h - 1))
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            b = jnp.arange(n)[:, None, None]
+            vals = x[b, :, iyc, ixc]                     # [N, Ho, Wo, C]
+            return jnp.where(inside[..., None], vals, 0.0)
+
+        if mode == "nearest":
+            out = gather(jnp.round(fx), jnp.round(fy))
+            return jnp.moveaxis(out, -1, 1)
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (x1 - fx) * (fy - y0)
+        wc = (fx - x0) * (y1 - fy)
+        wd = (fx - x0) * (fy - y0)
+        out = (gather(x0, y0) * wa[..., None] + gather(x0, y1) * wb[..., None]
+               + gather(x1, y0) * wc[..., None]
+               + gather(x1, y1) * wd[..., None])
+        return jnp.moveaxis(out, -1, 1)                  # [N, C, Ho, Wo]
+
+    _reg("grid_sample", impl)
+    return dispatch.apply("grid_sample", [x, grid],
+                          {"mode": str(mode),
+                           "padding_mode": str(padding_mode),
+                           "align": bool(align_corners)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across time segments (reference
+    vision.py:temporal_shift)."""
+    x = as_tensor(x)
+
+    def impl(x, *, seg, ratio, nchw):
+        import jax.numpy as jnp
+
+        if not nchw:
+            x = jnp.moveaxis(x, -1, 1)
+        nt, c, h, w = x.shape
+        xr = x.reshape(nt // seg, seg, c, h, w)
+        fold = int(c * ratio)
+        fwd = jnp.roll(xr[:, :, :fold], 1, axis=1).at[:, 0, :].set(0.0)
+        bwd = jnp.roll(xr[:, :, fold:2 * fold], -1, axis=1) \
+            .at[:, -1, :].set(0.0)
+        out = jnp.concatenate([fwd, bwd, xr[:, :, 2 * fold:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        return out if nchw else jnp.moveaxis(out, 1, -1)
+
+    _reg("temporal_shift", impl)
+    return dispatch.apply("temporal_shift", [x],
+                          {"seg": int(seg_num), "ratio": float(shift_ratio),
+                           "nchw": data_format == "NCHW"})
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W dims (reference common.py:zeropad2d)."""
+    from .common import pad as _pad
+
+    return _pad(x, padding, mode="constant", value=0.0,
+                data_format=data_format)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...] (reference input.py:sequence_mask)."""
+    from ...framework import dtype as dtype_mod
+
+    x = as_tensor(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._data).max())
+
+    def impl(lens, *, maxlen, dt):
+        import jax.numpy as jnp
+
+        rng = jnp.arange(maxlen)
+        return (rng < lens[..., None]).astype(dtype_mod.to_np(dt))
+
+    _reg("sequence_mask", impl)
+    return dispatch.apply("sequence_mask", [x],
+                          {"maxlen": int(maxlen), "dt": str(dtype)})
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference input.py / reference op
+    gather_tree): walk parent pointers from the last step; [T, B, W]."""
+    ids, parents = as_tensor(ids), as_tensor(parents)
+
+    def impl(ids, parents):
+        import jax
+        import jax.numpy as jnp
+
+        t, b, w = ids.shape
+        binx = jnp.arange(b)[:, None]
+        parents = parents.astype(jnp.int32)
+
+        def step(carry, xs):
+            beam = carry                                  # [B, W]
+            step_ids, step_parents = xs
+            out = step_ids[binx, beam]
+            beam = step_parents[binx, beam]
+            return beam, out
+
+        init = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32)[None, :],
+                                (b, w))
+        _, outs = jax.lax.scan(step, init, (ids, parents), reverse=True)
+        return outs                                       # [T, B, W]
+
+    _reg("gather_tree", impl)
+    return dispatch.apply("gather_tree", [ids, parents])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Gumbel-softmax sampling with straight-through option (reference
+    activation.py:gumbel_softmax)."""
+    import jax
+
+    x = as_tensor(x)
+    key = jax.random.key_data(random_mod.next_key())
+    key_t = Tensor(key, stop_gradient=True)
+
+    def impl(x, raw_key, *, temperature, hard, axis):
+        import jax.numpy as jnp
+
+        key = jax.random.wrap_key_data(raw_key)
+        u = jax.random.uniform(key, x.shape, jnp.float32, 1e-10, 1.0)
+        g = -jnp.log(-jnp.log(u))
+        y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = (jnp.arange(y.shape[axis]) ==
+                      jnp.moveaxis(idx, axis, -1)).astype(y.dtype)
+            onehot = jnp.moveaxis(onehot, -1, axis)
+            # straight-through: forward one-hot, backward soft
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+
+    _reg("gumbel_softmax", impl)
+    return dispatch.apply("gumbel_softmax", [x, key_t],
+                          {"temperature": float(temperature),
+                           "hard": bool(hard), "axis": int(axis)})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p over the last dim (reference
+    distance.py:pairwise_distance)."""
+    x, y = as_tensor(x), as_tensor(y)
+
+    def impl(x, y, *, p, eps, keepdim):
+        import jax.numpy as jnp
+
+        d = x - y + eps
+        return jnp.linalg.norm(d.astype(jnp.float32), ord=p, axis=-1,
+                               keepdims=keepdim).astype(x.dtype)
+
+    _reg("pairwise_distance", impl)
+    return dispatch.apply("pairwise_distance", [x, y],
+                          {"p": float(p), "eps": float(epsilon),
+                           "keepdim": bool(keepdim)})
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout zeroing WHOLE channels to the SELU negative
+    saturation value (reference common.py:feature_alpha_dropout)."""
+    import jax
+
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    if not 0 <= p < 1:
+        raise ValueError(f"p must be in [0, 1), got {p}")
+    key_t = Tensor(jax.random.key_data(random_mod.next_key()),
+                   stop_gradient=True)
+
+    def impl(x, raw_key, *, p):
+        import jax.numpy as jnp
+
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        key = jax.random.wrap_key_data(raw_key)
+        mask_shape = x.shape[:2] + (1,) * (x.ndim - 2)   # per-feature
+        keep = jax.random.bernoulli(key, 1 - p, mask_shape)
+        a = (1 - p + p * alpha_p ** 2) ** -0.5
+        b = -a * p * alpha_p
+        y = jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype))
+        return (a * y + b).astype(x.dtype)
+
+    _reg("feature_alpha_dropout", impl)
+    return dispatch.apply("feature_alpha_dropout", [x, key_t],
+                          {"p": float(p)})
+
+
+# -- pooling ----------------------------------------------------------------
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Scatter pooled values back by their argmax indices (reference
+    pooling.py:max_unpool1d)."""
+    x, indices = as_tensor(x), as_tensor(indices)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    pd = padding if isinstance(padding, int) else padding[0]
+    if output_size is None:
+        out_l = (x.shape[-1] - 1) * s - 2 * pd + k
+    else:
+        out_l = int(tuple(output_size)[-1])
+
+    def impl(x, idx, *, out_l):
+        import jax.numpy as jnp
+
+        n, c, l = x.shape
+        flat = jnp.zeros((n, c, out_l), x.dtype)
+        return flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], idx].set(x)
+
+    _reg("max_unpool1d", impl)
+    return dispatch.apply("max_unpool1d", [x, indices], {"out_l": out_l})
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """3-D inverse of max_pool3d (reference pooling.py:max_unpool3d);
+    indices are flat within each (n, c) volume."""
+    x, indices = as_tensor(x), as_tensor(indices)
+
+    def tup(v):
+        return (v,) * 3 if isinstance(v, int) else tuple(int(a) for a in v)
+
+    k, s = tup(kernel_size), tup(stride if stride is not None
+                                 else kernel_size)
+    pd = tup(padding)
+    if output_size is None:
+        d, h, w = x.shape[2:]
+        out_sz = tuple((m - 1) * st - 2 * p + kk for m, st, p, kk in
+                       zip((d, h, w), s, pd, k))
+    else:
+        out_sz = tuple(int(v) for v in tuple(output_size)[-3:])
+
+    def impl(x, idx, *, out_sz):
+        import jax.numpy as jnp
+
+        n, c = x.shape[:2]
+        numel = out_sz[0] * out_sz[1] * out_sz[2]
+        flat = jnp.zeros((n, c, numel), x.dtype)
+        xf = x.reshape(n, c, -1)
+        idxf = idx.reshape(n, c, -1)
+        flat = flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], idxf].set(xf)
+        return flat.reshape(n, c, *out_sz)
+
+    _reg("max_unpool3d", impl)
+    return dispatch.apply("max_unpool3d", [x, indices], {"out_sz": out_sz})
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Graham-style pseudo-random pooling boundaries: b_i = ceil(a*(i+u)),
+    windows [b_i, b_{i+1}) cover the input with sizes differing by <= 1."""
+    alpha = in_size / out_size
+    bounds = [0]
+    for i in range(1, out_size):
+        bounds.append(min(in_size - 1, int(math.ceil(alpha * (i + u))) - 1))
+    bounds.append(in_size)
+    return bounds
+
+
+def _fractional_pool(x_t, output_size, random_u, ndim, return_mask):
+    spatial = tuple(int(s) for s in x_t._data.shape[-ndim:])
+    out_sz = tuple(int(v) for v in (
+        (output_size,) * ndim if isinstance(output_size, int)
+        else tuple(output_size)))
+    if random_u is not None:
+        u = float(random_u)
+    else:
+        # a fresh draw per call from the framework generator (advances the
+        # key, so paddle.seed reproduces the SEQUENCE of pooling regions)
+        import jax
+
+        u = float(jax.random.uniform(random_mod.next_key(), ()))
+    all_bounds = tuple(tuple(_fractional_bounds(spatial[d], out_sz[d], u))
+                       for d in range(ndim))
+
+    def impl(x, *, bounds, ndim):
+        import jax.numpy as jnp
+
+        # pool by slicing per output cell: bounds are static attrs, so XLA
+        # fuses the max-reduces (window sizes vary by <=1)
+        slabs = x
+        for d in range(ndim):
+            b = bounds[d]
+            ax = x.ndim - ndim + d
+            pieces = [jnp.max(
+                jax.lax.slice_in_dim(slabs, b[i], b[i + 1], axis=ax),
+                axis=ax, keepdims=True) for i in range(len(b) - 1)]
+            slabs = jnp.concatenate(pieces, axis=ax)
+        return slabs
+
+    import jax  # noqa: F401  (used inside impl)
+
+    _reg(f"fractional_max_pool{ndim}d", impl)
+    out = dispatch.apply(f"fractional_max_pool{ndim}d", [x_t],
+                         {"bounds": all_bounds, "ndim": ndim})
+    if not return_mask:
+        return out
+    raise NotImplementedError(
+        "fractional_max_pool(return_mask=True): argmax-mask extraction is "
+        "not implemented on this build; use return_mask=False (the mask is "
+        "only needed for max_unpool round-trips)")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference pooling.py:fractional_max_pool2d;
+    Graham 2014 pseudo-random variant, deterministic given random_u)."""
+    return _fractional_pool(as_tensor(x), output_size, random_u, 2,
+                            return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(as_tensor(x), output_size, random_u, 3,
+                            return_mask)
+
+
+_bind_inplace_activations()

@@ -479,3 +479,338 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
         return (loss / manipulation.cast(ll, loss.dtype).clip(1)).mean()
     return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (OPS_PARITY gap list; reference
+# `python/paddle/nn/functional/loss.py`)
+# ---------------------------------------------------------------------------
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y| / (|X|+|Y|) per sample (reference loss.py:dice_loss).
+    `input` [N, ..., C] probabilities, `label` [N, ..., 1] class ids."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def impl(x, y, *, eps):
+        import jax
+        import jax.numpy as jnp
+
+        onehot = jax.nn.one_hot(y[..., 0], x.shape[-1], dtype=x.dtype)
+        reduce_axes = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * onehot, axis=reduce_axes)
+        union = jnp.sum(x, axis=reduce_axes) + jnp.sum(onehot,
+                                                      axis=reduce_axes)
+        return jnp.mean(1.0 - (2.0 * inter + eps) / (union + eps))
+
+    if "dice_loss" not in dispatch.op_registry():
+        dispatch.register_op("dice_loss", impl)
+    return dispatch.apply("dice_loss", [input, label],
+                          {"eps": float(epsilon)})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Improved-embedding N-pair loss (reference loss.py:npair_loss)."""
+    anchor, positive = as_tensor(anchor), as_tensor(positive)
+    labels = as_tensor(labels)
+
+    def impl(a, p, y, *, l2):
+        import jax.numpy as jnp
+
+        y = y.reshape(-1).astype(jnp.float32)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        logits = a @ p.T
+        lse = jax.nn.logsumexp(logits, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(tgt * (lse - logits), axis=1))
+        reg = l2 * 0.25 * (jnp.mean(jnp.sum(a * a, axis=1))
+                           + jnp.mean(jnp.sum(p * p, axis=1)))
+        return xent + reg
+
+    import jax  # noqa: F401
+
+    if "npair_loss" not in dispatch.op_registry():
+        dispatch.register_op("npair_loss", impl)
+    return dispatch.apply("npair_loss", [anchor, positive, labels],
+                          {"l2": float(l2_reg)})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (reference loss.py:multi_margin_loss)."""
+    input, label = as_tensor(input), as_tensor(label)
+
+    def impl(x, y, w, *, p, margin, has_w):
+        import jax.numpy as jnp
+
+        n, c = x.shape
+        target = x[jnp.arange(n), y]                    # [N]
+        m = jnp.maximum(0.0, margin - target[:, None] + x) ** p
+        if has_w:
+            m = m * w[y][:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        return jnp.sum(m, axis=1) / c
+
+    if "multi_margin_loss" not in dispatch.op_registry():
+        dispatch.register_op("multi_margin_loss", impl)
+    w = as_tensor(weight) if weight is not None else Tensor(
+        np.zeros((1,), np.float32), stop_gradient=True)
+    loss = dispatch.apply("multi_margin_loss", [input, label, w],
+                          {"p": int(p), "margin": float(margin),
+                           "has_w": weight is not None})
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a custom distance callable (reference
+    loss.py:triplet_margin_with_distance_loss)."""
+    from ..functional.extended import pairwise_distance
+
+    d = distance_function if distance_function is not None else \
+        (lambda a, b: pairwise_distance(a, b, p=2.0))
+    input, positive, negative = (as_tensor(input), as_tensor(positive),
+                                 as_tensor(negative))
+    dp = d(input, positive)
+    dn = d(input, negative)
+    if swap:
+        from ...ops.math import minimum
+
+        dn = minimum(dn, d(positive, negative))
+    from ...ops.math import maximum
+
+    zero = Tensor(np.zeros((), np.float32), stop_gradient=True)
+    loss = maximum(dp - dn + margin, zero)
+    return _reduce(loss, reduction)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference
+    loss.py:margin_cross_entropy): target logit cos(m1*t + m2) - m3,
+    scaled CE. Single-device form (the model-parallel variant shards the
+    class dim via the auto-parallel engine instead of a bespoke op)."""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def impl(x, y, *, m1, m2, m3, s):
+        import jax
+        import jax.numpy as jnp
+
+        n = x.shape[0]
+        cos_t = jnp.clip(x[jnp.arange(n), y], -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        adj = jnp.cos(m1 * theta + m2) - m3
+        z = x.at[jnp.arange(n), y].set(adj) * s
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -logp[jnp.arange(n), y]
+        return loss, jax.nn.softmax(z, axis=-1)
+
+    if "margin_cross_entropy" not in dispatch.op_registry():
+        dispatch.register_op("margin_cross_entropy", impl, multi_out=True)
+    loss, softmax = dispatch.apply(
+        "margin_cross_entropy", [logits, label],
+        {"m1": float(margin1), "m2": float(margin2), "m3": float(margin3),
+         "s": float(scale)})
+    loss = _reduce(loss, reduction)
+    return (loss, softmax) if return_softmax else loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference loss.py:rnnt_loss; Graves 2012).
+    TPU-first: the alpha lattice recursion runs as nested `lax.scan`s —
+    outer over T, inner over U — one compiled program, batched over B."""
+    input = as_tensor(input)                   # [B, T, U+1, V] logits
+    label = as_tensor(label)                   # [B, U] int
+    input_lengths = as_tensor(input_lengths)
+    label_lengths = as_tensor(label_lengths)
+
+    def impl(x, y, t_lens, u_lens, *, blank, fastemit_lambda):
+        import jax
+        import jax.numpy as jnp
+
+        b, t_max, u1, v = x.shape
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        blank_lp_full = logp[..., blank]                   # [B, T, U+1]
+        # label transition logprob at (t, u): emit y[u] -> [B, T, U]
+        yexp = jnp.broadcast_to(y[:, None, :], (b, t_max, u1 - 1))
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], yexp[..., None], axis=-1)[..., 0]
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def lattice(blank_lp):
+            """-log P(y|x) via the alpha recursion over (T, U)."""
+
+            def t_step(alpha_prev, xs):
+                blank_tm1, lab_t = xs                      # [B,U+1], [B,U]
+                from_blank = alpha_prev + blank_tm1        # stay in row
+
+                def u_step(carry, uidx):
+                    fb = from_blank[:, uidx]
+                    lab = jnp.where(
+                        uidx > 0,
+                        carry + lab_t[:, jnp.maximum(uidx - 1, 0)], neg_inf)
+                    a = jnp.logaddexp(fb, lab)
+                    return a, a
+
+                _, cols = jax.lax.scan(u_step, jnp.full((b,), neg_inf),
+                                       jnp.arange(u1))
+                return jnp.swapaxes(cols, 0, 1), None
+
+            def u0_step(carry, uidx):
+                a = jnp.where(uidx > 0,
+                              carry + lab_lp[:, 0, jnp.maximum(uidx - 1, 0)],
+                              jnp.zeros((b,), jnp.float32))
+                return a, a
+
+            _, cols0 = jax.lax.scan(u0_step, jnp.zeros((b,), jnp.float32),
+                                    jnp.arange(u1))
+            alpha0 = jnp.swapaxes(cols0, 0, 1)             # [B, U+1]
+
+            def scan_t(alpha, tidx):
+                new = t_step(alpha, (blank_lp[:, tidx - 1],
+                                     lab_lp[:, tidx]))[0]
+                keep = (tidx < t_lens)[:, None]
+                out = jnp.where(keep, new, alpha)
+                return out, None
+
+            alpha_T, _ = jax.lax.scan(scan_t, alpha0, jnp.arange(1, t_max))
+            u_idx = u_lens.astype(jnp.int32)
+            b_idx = jnp.arange(b)
+            t_idx = (t_lens - 1).astype(jnp.int32)
+            return -(alpha_T[b_idx, u_idx]
+                     + blank_lp[b_idx, t_idx, u_idx])
+
+        loss = lattice(blank_lp_full)
+        if fastemit_lambda:
+            # FastEmit (Yu et al. 2021): scale LABEL-emission gradients by
+            # (1 + lambda) without changing the reported loss VALUE.
+            # L' sees the blank logprobs as CONSTANTS (its gradient is the
+            # label-path part only); (L' - stop_grad(L')) is a zero-value
+            # gradient carrier.
+            fe = lattice(jax.lax.stop_gradient(blank_lp_full))
+            loss = loss + fastemit_lambda * (fe - jax.lax.stop_gradient(fe))
+        return loss
+
+    if "rnnt_loss" not in dispatch.op_registry():
+        dispatch.register_op("rnnt_loss", impl)
+    loss = dispatch.apply("rnnt_loss",
+                          [input, label, input_lengths, label_lengths],
+                          {"blank": int(blank),
+                           "fastemit_lambda": float(fastemit_lambda)})
+    return _reduce(loss, reduction)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py:adaptive_log_softmax_with_loss;
+    Grave et al.): frequent classes in the head, rare classes in projected
+    tail clusters. Returns (per-sample logprob of the target, mean loss).
+    Differentiable: the whole composite runs through dispatch."""
+    input, label = as_tensor(input), as_tensor(label)
+    cutoffs = [int(c) for c in cutoffs]
+    n_clusters = len(tail_weights)
+
+    def impl(x, y, hw, hb, *arrays, cutoffs, has_bias):
+        import jax
+        import jax.numpy as jnp
+
+        head_logits = x @ hw
+        if has_bias:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        shortlist = y < cutoffs[0]
+        safe_head_y = jnp.where(shortlist, y, 0)
+        out = jnp.where(shortlist,
+                        jnp.take_along_axis(head_logp, safe_head_y[:, None],
+                                            axis=1)[:, 0], 0.0)
+        low = cutoffs[0]
+        for i in range(len(arrays) // 2):
+            high = cutoffs[i + 1]
+            proj, cls_w = arrays[2 * i], arrays[2 * i + 1]
+            in_cluster = (y >= low) & (y < high)
+            tail_logp = jax.nn.log_softmax((x @ proj) @ cls_w, axis=-1)
+            rel = jnp.clip(y - low, 0, high - low - 1)
+            contrib = head_logp[:, cutoffs[0] + i] + jnp.take_along_axis(
+                tail_logp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_cluster, contrib, out)
+            low = high
+        return out, -jnp.mean(out)
+
+    opname = f"adaptive_lsm_{n_clusters}"
+    if opname not in dispatch.op_registry():
+        dispatch.register_op(opname, impl, multi_out=True)
+    hb = as_tensor(head_bias) if head_bias is not None else Tensor(
+        np.zeros((1,), np.float32), stop_gradient=True)
+    flat_tails = []
+    for proj, cls_w in tail_weights:
+        flat_tails += [as_tensor(proj), as_tensor(cls_w)]
+    out, loss = dispatch.apply(
+        opname, [input, label, as_tensor(head_weight), hb] + flat_tails,
+        {"cutoffs": tuple(cutoffs), "has_bias": head_bias is not None})
+    return out, loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference loss.py:hsigmoid_loss): the [num_classes] softmax becomes
+    ~log2(C) sigmoids along the heap path root->leaf. Custom trees come in
+    via path_table/path_code; the default table is precomputed on the host
+    and gathered per sample (static shapes)."""
+    input, label = as_tensor(input), as_tensor(label)
+    weight = as_tensor(weight)
+
+    if path_table is None:
+        # default complete-binary-heap paths: leaf for class c is node c+C;
+        # internal node ids 1..C-1 map to weight rows id-1
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        table = np.full((num_classes, depth), -1, np.int32)
+        code = np.zeros((num_classes, depth), np.int32)
+        for c in range(num_classes):
+            node = c + num_classes
+            path = []
+            while node > 1:
+                path.append((node // 2, node % 2))
+                node //= 2
+            for d, (parent, bit) in enumerate(reversed(path)):
+                if d < depth:
+                    table[c, d] = parent - 1
+                    code[c, d] = bit
+        path_table = Tensor(table, stop_gradient=True)
+        path_code = Tensor(code, stop_gradient=True)
+    else:
+        path_table = as_tensor(path_table)
+        path_code = as_tensor(path_code)
+
+    def impl(x, y, w, b, table, codes, *, has_bias):
+        import jax
+        import jax.numpy as jnp
+
+        rows = table[y]                               # [N, depth]
+        bits = codes[y].astype(jnp.float32)
+        valid = (rows >= 0)
+        safe = jnp.maximum(rows, 0)
+        wv = w[safe]                                  # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x, wv)
+        if has_bias:
+            logits = logits + b[safe][..., 0] if b.ndim == 2 else \
+                logits + b[safe]
+        # BCE with target = 1 - bit (paddle code convention: bit==branch)
+        tgt = 1.0 - bits
+        per = jnp.maximum(logits, 0) - logits * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        per = jnp.where(valid, per, 0.0)
+        return jnp.sum(per, axis=1, keepdims=True)
+
+    if "hsigmoid_loss" not in dispatch.op_registry():
+        dispatch.register_op("hsigmoid_loss", impl)
+    b = as_tensor(bias) if bias is not None else Tensor(
+        np.zeros((1,), np.float32), stop_gradient=True)
+    return dispatch.apply(
+        "hsigmoid_loss", [input, label, weight, b, path_table, path_code],
+        {"has_bias": bias is not None})

@@ -154,6 +154,8 @@ _METHODS = dict(
     log_normal_=extended.log_normal_, cauchy_=extended.cauchy_,
     geometric_=extended.geometric_, bernoulli_=extended.bernoulli_,
     exponential_=extended.exponential_, tensor_split=extended.tensor_split,
+    uniform_=extended.uniform_, top_p_sampling=extended.top_p_sampling,
+    create_tensor=extended.create_tensor,
 )
 _METHODS.update(extended._INPLACE)
 

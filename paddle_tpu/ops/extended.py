@@ -1070,6 +1070,8 @@ def _build_inplace_table():
     other_sources["tril"] = _tril
     other_sources["triu"] = _triu
     other_sources.pop("fill_diagonal")
+    other_sources["lerp"] = math_ops.lerp
+    other_sources["put_along_axis"] = manipulation.put_along_axis
     for name, fn in {**unary_sources, **binary_sources, **other_sources}.items():
         table[name + "_"] = _make_inplace(fn)
     table["floor_mod_"] = table["mod_"]
@@ -1099,5 +1101,61 @@ __all__ = [
     "log_normal", "normal_", "log_normal_", "cauchy_", "geometric_",
     "bernoulli_", "exponential_", "randint_like", "finfo", "iinfo", "tolist",
     "set_printoptions", "disable_signal_handler", "batch", "check_shape",
-    "add_n", "addmm_",
+    "add_n", "addmm_", "uniform_", "top_p_sampling", "create_tensor",
 ] + sorted(_INPLACE)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place uniform refill (reference tensor method `uniform_`)."""
+    from .creation import uniform as _uniform
+    from .manipulation import cast as _cast
+
+    out = _uniform(tuple(x.shape), dtype=np.dtype(x._data.dtype).name,
+                   min=min, max=max, seed=seed)
+    return inplace_rebind(x, out)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty typed tensor placeholder (reference
+    `tensor/creation.py:create_tensor`)."""
+    from ..framework import dtype as dtype_mod
+
+    return Tensor(np.zeros((0,), dtype_mod.to_np(dtype)),
+                  stop_gradient=True)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over the last axis (reference
+    `tensor/search.py:top_p_sampling`): keep the smallest prefix of the
+    sorted distribution with cumulative prob >= p, renormalize, sample.
+    Returns (sampled values, sampled ids)."""
+    from ..framework import random as random_mod
+
+    x, ps = as_tensor(x), as_tensor(ps)
+    import jax
+
+    key_t = Tensor(jax.random.key_data(random_mod.next_key()),
+                   stop_gradient=True)
+
+    def impl(x, ps, raw_key):
+        import jax.numpy as jnp
+
+        probs = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens while the EXCLUSIVE prefix sum < p (first token always)
+        keep = (cum - sorted_p) < ps[..., None]
+        filtered = jnp.where(keep, sorted_p, 0.0)
+        filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+        key = jax.random.wrap_key_data(raw_key)
+        draw = jax.random.categorical(key, jnp.log(filtered + 1e-30),
+                                      axis=-1)
+        ids = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1).astype(x.dtype)
+        return vals, ids.astype(jnp.int64)
+
+    if "top_p_sampling" not in dispatch.op_registry():
+        dispatch.register_op("top_p_sampling", impl, multi_out=True)
+    return dispatch.apply("top_p_sampling", [x, ps, key_t])

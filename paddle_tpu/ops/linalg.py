@@ -194,11 +194,24 @@ def cholesky_solve(x, y, upper=False, name=None):
     return dispatch.apply("cholesky_solve", [as_tensor(x), as_tensor(y)], {"upper": bool(upper)})
 
 
-_reg("lu_op", lambda x: tuple(jax.scipy.linalg.lu(x)), multi_out=True)
+def _lu_impl(x):
+    lu_packed, pivots, _perm = jax.lax.linalg.lu(x)
+    return lu_packed, (pivots + 1).astype(jnp.int32)  # 1-based (reference)
+
+
+_reg("lu_op", _lu_impl, multi_out=True)
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
-    return tuple(dispatch.apply("lu_op", [as_tensor(x)]))
+    """Packed LU factorization -> (LU, pivots[, infos]) in the reference
+    contract (`tensor/linalg.py:lu`): combined L\\U matrix + 1-based pivot
+    swaps; `lu_unpack` recovers (P, L, U)."""
+    lu_packed, pivots = dispatch.apply("lu_op", [as_tensor(x)])
+    if get_infos:
+        info = Tensor(jnp.zeros(lu_packed._data.shape[:-2], jnp.int32),
+                      stop_gradient=True)
+        return lu_packed, pivots, info
+    return lu_packed, pivots
 
 
 _reg("det", jnp.linalg.det)
@@ -302,3 +315,201 @@ def matrix_transpose(x, name=None):
     from .manipulation import swapaxes
 
     return swapaxes(x, -1, -2)
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (reference `python/paddle/linalg.py` __all__)
+# ---------------------------------------------------------------------------
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Vector p-norm over `axis` (reference tensor/linalg.py:vector_norm)."""
+    _reg("vector_norm_op", lambda x, *, p, axis, keepdim: _pnorm_impl(
+        x, p, axis, keepdim))
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return dispatch.apply("vector_norm_op", [as_tensor(x)],
+                          {"p": float(p), "axis": ax,
+                           "keepdim": bool(keepdim)})
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """Matrix norm over the two `axis` dims: fro/nuc/±1/±2/±inf
+    (reference tensor/linalg.py:matrix_norm)."""
+
+    def impl(x, *, p, axis, keepdim):
+        x = jnp.moveaxis(x, axis, (-2, -1))
+        out = jnp.linalg.norm(x, ord=p, axis=(-2, -1))
+        if keepdim:
+            for a in sorted(axis):
+                out = jnp.expand_dims(out, a)
+        return out
+
+    _reg("matrix_norm_op", impl)
+    pk = p if isinstance(p, (int, float)) else str(p)
+    if isinstance(pk, str) and pk in ("inf", "-inf"):
+        pk = float(pk)
+    return dispatch.apply("matrix_norm_op", [as_tensor(x)],
+                          {"p": pk, "axis": tuple(axis),
+                           "keepdim": bool(keepdim)})
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference tensor/linalg.py:matrix_exp; XLA path
+    is jax.scipy.linalg.expm — Pade + scaling-and-squaring)."""
+    _reg("matrix_exp_op", lambda x: jax.scipy.linalg.expm(x))
+    return dispatch.apply("matrix_exp_op", [as_tensor(x)])
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    linalg.cholesky_inverse): (LL^T)^-1 via two triangular solves."""
+
+    def impl(f, *, upper):
+        eye = jnp.eye(f.shape[-1], dtype=f.dtype)
+        return jax.scipy.linalg.cho_solve((f, not upper), eye)
+
+    _reg("cholesky_inverse_op", impl)
+    return dispatch.apply("cholesky_inverse_op", [as_tensor(x)],
+                          {"upper": bool(upper)})
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (geqrf output; reference
+    linalg.householder_product; XLA primitive
+    lax.linalg.householder_product)."""
+    _reg("householder_product_op",
+         lambda a, taus: jax.lax.linalg.householder_product(a, taus))
+    return dispatch.apply("householder_product_op",
+                          [as_tensor(x), as_tensor(tau)])
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply `y` by Q (from Householder factors `x`, `tau`) without
+    forming A (reference linalg.ormqr). XLA has no ormqr primitive, so Q is
+    materialized via householder_product and applied as a gemm — same
+    asymptotics on TPU where the gemm is the fast path."""
+
+    def impl(a, taus, y, *, left, transpose):
+        q = jax.lax.linalg.householder_product(a, taus)
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qq, y) if left else jnp.matmul(y, qq)
+
+    _reg("ormqr_op", impl)
+    return dispatch.apply("ormqr_op",
+                          [as_tensor(x), as_tensor(tau), as_tensor(y)],
+                          {"left": bool(left), "transpose": bool(transpose)})
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(P, L, U) from the packed LU factorization (reference
+    linalg.lu_unpack). `y` is the 1-based pivot vector `linalg.lu`
+    returns."""
+    xt, yt = as_tensor(x), as_tensor(y)
+
+    def impl(lu_data, pivots, *, unpack_ludata, unpack_pivots):
+        m, n = lu_data.shape[-2], lu_data.shape[-1]
+        k = min(m, n)
+        if unpack_ludata:
+            tril = jnp.tril(lu_data[..., :, :k], k=-1)
+            l_mat = tril + jnp.eye(m, k, dtype=lu_data.dtype)
+            u_mat = jnp.triu(lu_data[..., :k, :])
+        else:
+            l_mat = u_mat = jnp.zeros((0,), lu_data.dtype)
+        if unpack_pivots:
+            def one_perm(piv1d):
+                # apply row swaps to the identity: P = swaps(I)
+                piv = piv1d.astype(jnp.int32) - 1    # 1-based -> 0-based
+
+                def swap(i, perm):
+                    j = piv[i]
+                    pi, pj = perm[i], perm[j]
+                    return perm.at[i].set(pj).at[j].set(pi)
+
+                perm = jax.lax.fori_loop(0, piv.shape[-1], swap,
+                                         jnp.arange(m))
+                return jnp.eye(m, dtype=lu_data.dtype)[:, perm]
+
+            flat = pivots.reshape((-1, pivots.shape[-1]))
+            p_mat = jax.vmap(one_perm)(flat).reshape(
+                pivots.shape[:-1] + (m, m))
+            if pivots.ndim == 1:
+                p_mat = p_mat.reshape(m, m)
+        else:
+            p_mat = jnp.zeros((0,), lu_data.dtype)
+        return p_mat, l_mat, u_mat
+
+    _reg("lu_unpack_op", impl, multi_out=True)
+    return dispatch.apply("lu_unpack_op", [xt, yt],
+                          {"unpack_ludata": bool(unpack_ludata),
+                           "unpack_pivots": bool(unpack_pivots)})
+
+
+def _randn_like(shape, dtype):
+    from ..framework import random as random_mod
+
+    return jax.random.normal(random_mod.next_key(), shape, dtype)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference linalg.svd_lowrank; Halko et al.
+    randomized range finder — q-dim sketch + `niter` power iterations,
+    MXU-friendly: all work is tall-skinny gemms + a tiny dense SVD)."""
+    xt = as_tensor(x)
+    omega = Tensor(_randn_like((xt._data.shape[-1], int(q)),
+                               xt._data.dtype), stop_gradient=True)
+
+    def impl(a, omega, m_off, *, niter, has_m):
+        if has_m:
+            a = a - m_off
+        y = a @ omega
+        qmat, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            z, _ = jnp.linalg.qr(jnp.swapaxes(a, -1, -2) @ qmat)
+            qmat, _ = jnp.linalg.qr(a @ z)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+
+    _reg("svd_lowrank_op", impl, multi_out=True)
+    m_arg = as_tensor(M) if M is not None else Tensor(
+        jnp.zeros((1,), xt._data.dtype), stop_gradient=True)
+    return dispatch.apply("svd_lowrank_op", [xt, omega, m_arg],
+                          {"niter": int(niter), "has_m": M is not None})
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference linalg.pca_lowrank): center then
+    svd_lowrank."""
+    xt = as_tensor(x)
+    if q is None:
+        q = min(6, xt._data.shape[-2], xt._data.shape[-1])
+    if center:
+        from .manipulation import unsqueeze
+        from .reduction import mean
+
+        m = unsqueeze(mean(xt, axis=-2), -2)
+        return svd_lowrank(xt - m, q=q, niter=niter)
+    return svd_lowrank(xt, q=q, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", name=None):
+    """fp8 x fp8 -> half gemm (reference
+    `linalg.fp8_fp8_half_gemm_fused` over cutlass): inputs are
+    float8_e4m3fn, accumulation f32, output bf16/f16 scaled by `scale`."""
+
+    def impl(x, y, *, tx, ty, scale, out_dtype):
+        a = jnp.swapaxes(x, -1, -2) if tx else x
+        b = jnp.swapaxes(y, -1, -2) if ty else y
+        out = jnp.matmul(a.astype(jnp.float32), b.astype(jnp.float32))
+        return (out * scale).astype(dtype_mod.to_np(out_dtype))
+
+    _reg("fp8_gemm_op", impl)
+    out = dispatch.apply("fp8_gemm_op", [as_tensor(x), as_tensor(y)],
+                         {"tx": bool(transpose_x), "ty": bool(transpose_y),
+                          "scale": float(scale),
+                          "out_dtype": str(output_dtype)})
+    if bias is not None:
+        out = out + as_tensor(bias)
+    return out

@@ -69,7 +69,16 @@ class SparseTensor:
         return Tensor(self._data.indices)
 
     def to_dense(self) -> Tensor:
-        return Tensor(self._data.todense())
+        import jax.numpy as jnp
+
+        data = self._data
+        if data.dtype == jnp.bool_:
+            # BCOO.todense scatter-adds, which rejects bool: round-trip int8
+            as_int = type(data)((data.data.astype(jnp.int8), data.indices),
+                                shape=data.shape) if hasattr(data, "indices") \
+                else data
+            return Tensor(as_int.todense().astype(jnp.bool_))
+        return Tensor(data.todense())
 
     def to_sparse_csr(self) -> "SparseTensor":
         from jax.experimental import sparse as jsparse
@@ -301,3 +310,147 @@ class _SparseReLU:
 
 class nn:  # namespace parity: paddle.sparse.nn
     ReLU = _SparseReLU
+
+
+# ---------------------------------------------------------------------------
+# round-4 parity additions (reference `python/paddle/sparse/__init__.py`
+# __all__): remaining unary family + structure ops
+# ---------------------------------------------------------------------------
+
+
+def _unary_np(name, jfn):
+    def op(x, name_=None):
+        return _unary(x, jfn)
+
+    op.__name__ = name
+    return op
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+asin = _unary_np("asin", lambda v: _jnp().arcsin(v))
+asinh = _unary_np("asinh", lambda v: _jnp().arcsinh(v))
+atan = _unary_np("atan", lambda v: _jnp().arctan(v))
+atanh = _unary_np("atanh", lambda v: _jnp().arctanh(v))
+sinh = _unary_np("sinh", lambda v: _jnp().sinh(v))
+tan = _unary_np("tan", lambda v: _jnp().tan(v))
+expm1 = _unary_np("expm1", lambda v: _jnp().expm1(v))
+log1p = _unary_np("log1p", lambda v: _jnp().log1p(v))
+square = _unary_np("square", lambda v: v * v)
+deg2rad = _unary_np("deg2rad", lambda v: _jnp().deg2rad(v))
+rad2deg = _unary_np("rad2deg", lambda v: _jnp().rad2deg(v))
+isnan = _unary_np("isnan", lambda v: _jnp().isnan(v))
+
+
+def cast(x: SparseTensor, index_dtype=None, value_dtype=None, name=None):
+    """Cast index/value dtypes (reference sparse/unary.py:cast)."""
+    from ..framework import dtype as dtype_mod
+
+    coo = _coo(x)
+    vals = coo.data if value_dtype is None else coo.data.astype(
+        dtype_mod.to_np(value_dtype))
+    idx = coo.indices if index_dtype is None else coo.indices.astype(
+        dtype_mod.to_np(index_dtype))
+    return _rewrap(x, type(coo)((vals, idx), shape=coo.shape))
+
+
+def divide(x: SparseTensor, y, name=None):
+    """Elementwise divide (scalar or same-pattern sparse; reference
+    sparse/binary.py:divide)."""
+    if isinstance(y, (int, float)):
+        return _unary(x, lambda v: v / y)
+    if isinstance(y, SparseTensor):
+        return from_dense(Tensor(_coo(x).todense() / _coo(y).todense()),
+                          fmt=x._fmt)
+    raise TypeError("sparse.divide expects scalar or sparse")
+
+
+def coalesce(x: SparseTensor, name=None):
+    """Merge duplicate coordinates (reference sparse/unary.py:coalesce)."""
+    return x.coalesce()
+
+
+def is_same_shape(x, y) -> bool:
+    """Shape equality across sparse/dense operands (reference
+    sparse/unary.py:is_same_shape)."""
+    xs = x.shape if not isinstance(x, Tensor) else list(x.shape)
+    ys = y.shape if not isinstance(y, Tensor) else list(y.shape)
+    return list(xs) == list(ys)
+
+
+def mask_as(x, mask: SparseTensor, name=None):
+    """Take dense `x`'s entries at `mask`'s sparsity pattern (reference
+    sparse/unary.py:mask_as)."""
+    import jax.numpy as jnp
+
+    coo = _coo(mask).sum_duplicates()
+    dense = _arr(x)
+    vals = dense[tuple(coo.indices[:, d] for d in range(coo.indices.shape[1]))]
+    return _rewrap(mask, type(coo)((vals.astype(coo.data.dtype),
+                                    coo.indices), shape=coo.shape))
+
+
+def mv(x: SparseTensor, vec, name=None):
+    """Sparse matrix @ dense vector (reference sparse/binary.py:mv)."""
+    return matmul(x, vec)
+
+
+def addmm(input, x: SparseTensor, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference sparse/binary.py:addmm)."""
+    out = matmul(x, y)
+    inp = input if isinstance(input, Tensor) else Tensor(_arr(input))
+    return inp * beta + out * alpha
+
+
+def reshape(x: SparseTensor, shape, name=None):
+    """Reshape preserving sparsity (reference sparse/unary.py:reshape) —
+    re-derives coordinates through the dense intermediate (BCOO has no
+    native nd reshape); fine at the API-parity scale."""
+    import jax.numpy as jnp
+
+    dense = _coo(x).todense().reshape(tuple(int(s) for s in shape))
+    return from_dense(Tensor(dense), fmt=x._fmt)
+
+
+import builtins as _builtins  # noqa: E402
+
+
+def slice(x: SparseTensor, axes, starts, ends, name=None):
+    """Slice along `axes` (reference sparse/unary.py:slice)."""
+    dense = _coo(x).todense()
+    sl = [_builtins.slice(None)] * dense.ndim
+    for a, s, e in zip(axes, starts, ends):
+        sl[int(a)] = _builtins.slice(int(s), int(e))
+    return from_dense(Tensor(dense[tuple(sl)]), fmt=x._fmt)
+
+
+def sum(x: SparseTensor, axis=None, dtype=None, keepdim=False, name=None):
+    """Sum over the sparse tensor (reference sparse/unary.py:sum). Full
+    reductions sum the stored values directly; axis reductions go through
+    the dense intermediate."""
+    import jax.numpy as jnp
+
+    if axis is None:
+        v = jnp.sum(_coo(x).data)
+        if dtype is not None:
+            from ..framework import dtype as dtype_mod
+
+            v = v.astype(dtype_mod.to_np(dtype))
+        return Tensor(v, stop_gradient=True)
+    dense = _coo(x).todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    return from_dense(Tensor(out), fmt=x._fmt)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA accepting a sparse operand (reference
+    sparse/binary.py:pca_lowrank): densify then share
+    `linalg.pca_lowrank` (the sketching gemms dominate either way)."""
+    from ..ops import linalg as linalg_ops
+
+    dense = Tensor(_coo(x).todense()) if isinstance(x, SparseTensor) else x
+    return linalg_ops.pca_lowrank(dense, q=q, center=center, niter=niter)

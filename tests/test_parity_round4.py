@@ -1,0 +1,388 @@
+"""Round-4 parity-gap closure tests: linalg additions, nn.functional
+additions (spatial/pool/losses/attention variants), new layers, sparse
+ops, distributions — all numerically checked (closed forms / scipy /
+brute force).
+"""
+import numpy as np
+import pytest
+import scipy.linalg as sla
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor as T
+import paddle_tpu.nn.functional as F
+
+L = paddle.linalg
+rng = np.random.default_rng(0)
+
+
+class TestLinalgAdditions:
+    def test_norms(self):
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            float(L.vector_norm(T(a.ravel()), 2).numpy()),
+            np.linalg.norm(a.ravel()), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(L.matrix_norm(T(a), "fro").numpy()),
+            np.linalg.norm(a, "fro"), rtol=1e-5)
+        np.testing.assert_allclose(float(L.matrix_norm(T(a), 2).numpy()),
+                                   np.linalg.norm(a, 2), rtol=1e-4)
+
+    def test_matrix_exp(self):
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(L.matrix_exp(T(a))._data),
+                                   sla.expm(a), rtol=1e-4, atol=1e-5)
+
+    def test_cholesky_inverse_and_lu_roundtrip(self):
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        f = np.linalg.cholesky(spd)
+        np.testing.assert_allclose(
+            np.asarray(L.cholesky_inverse(T(f))._data),
+            np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+        lu_t, piv = L.lu(T(spd))
+        P, Lm, U = L.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(
+            np.asarray(P._data) @ np.asarray(Lm._data)
+            @ np.asarray(U._data), spd, rtol=1e-4, atol=1e-4)
+        _, _, info = L.lu(T(spd), get_infos=True)
+        assert int(info.numpy()) == 0
+
+    def test_householder_product_and_ormqr(self):
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        (qr_raw, tau), _ = sla.qr(a, mode="raw")
+        qr_raw = np.asarray(qr_raw, np.float32)
+        tau = np.asarray(tau, np.float32)
+        q = np.asarray(L.householder_product(T(qr_raw), T(tau))._data)
+        np.testing.assert_allclose(np.abs(q.T @ q), np.eye(4), atol=1e-4)
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(L.ormqr(T(qr_raw), T(tau), T(y))._data), q @ y,
+            rtol=2e-4, atol=2e-4)
+
+    def test_lowrank(self):
+        big = (rng.normal(size=(30, 3))
+               @ rng.normal(size=(3, 20))).astype(np.float32)
+        u, s, v = L.svd_lowrank(T(big), q=5)
+        np.testing.assert_allclose(
+            np.asarray(u._data) @ np.diag(np.asarray(s._data))
+            @ np.asarray(v._data).T, big, atol=1e-3)
+        u, s, v = L.pca_lowrank(T(big), q=3)
+        assert np.asarray(s._data).shape[-1] == 3
+
+    def test_fp8_gemm(self):
+        import jax.numpy as jnp
+
+        xa = jnp.asarray(rng.normal(size=(8, 16)), jnp.float8_e4m3fn)
+        yb = jnp.asarray(rng.normal(size=(16, 8)), jnp.float8_e4m3fn)
+        out = L.fp8_fp8_half_gemm_fused(T(xa), T(yb), output_dtype="float16")
+        assert str(out._data.dtype) == "float16"
+        ref = np.asarray(xa, np.float32) @ np.asarray(yb, np.float32)
+        np.testing.assert_allclose(np.asarray(out._data, np.float32), ref,
+                                   rtol=1e-2, atol=1e-2)
+
+
+class TestFunctionalAdditions:
+    def test_grid_sample_identity(self):
+        x = rng.normal(size=(1, 2, 5, 7)).astype(np.float32)
+        theta = np.asarray([[[1, 0, 0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(T(theta), [1, 2, 5, 7])
+        out = F.grid_sample(T(x), grid)
+        np.testing.assert_allclose(np.asarray(out._data), x, atol=1e-5)
+
+    def test_sequence_mask_and_gather_tree(self):
+        m = F.sequence_mask(T(np.array([2, 4])), maxlen=5)
+        assert np.asarray(m._data).tolist() == [[1, 1, 0, 0, 0],
+                                                [1, 1, 1, 1, 0]]
+        # the reference docstring worked example (extension.py:gather_tree)
+        ids = T(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                          [[0, 1], [9, 0]]]))
+        parents = T(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                              [[0, 0], [0, 1]]]))
+        gt = np.asarray(F.gather_tree(ids, parents)._data)
+        assert gt.tolist() == [[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                               [[0, 1], [9, 0]]]
+
+    def test_gumbel_pairwise_inplace(self):
+        g = F.gumbel_softmax(T(rng.normal(size=(4, 6)).astype(np.float32)),
+                             hard=True)
+        ga = np.asarray(g._data)
+        assert np.allclose(ga.sum(1), 1)
+        assert set(np.unique(ga)).issubset({0.0, 1.0})
+        a = rng.normal(size=(3, 4)).astype(np.float32)
+        b = rng.normal(size=(3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(F.pairwise_distance(T(a), T(b))._data),
+            np.linalg.norm(a - b + 1e-6, axis=-1), rtol=1e-5)
+        t = T(np.array([-1.0, 2.0], np.float32))
+        F.relu_(t)
+        assert np.asarray(t._data).tolist() == [0.0, 2.0]
+
+    def test_unpool_and_fractional(self):
+        xp = rng.normal(size=(1, 1, 8)).astype(np.float32)
+        pooled, idx = F.max_pool1d(T(xp), 2, stride=2, return_mask=True)
+        assert F.max_unpool1d(pooled, idx, 2, stride=2).shape == [1, 1, 8]
+        fp = F.fractional_max_pool2d(
+            T(rng.normal(size=(1, 1, 8, 8)).astype(np.float32)),
+            output_size=3, random_u=0.5)
+        assert fp.shape == [1, 1, 3, 3]
+        assert F.temporal_shift(
+            T(rng.normal(size=(4, 4, 2, 2)).astype(np.float32)),
+            seg_num=2).shape == [4, 4, 2, 2]
+
+    def test_new_losses_finite(self):
+        dl = F.dice_loss(
+            T(np.abs(rng.normal(size=(2, 5, 3))).astype(np.float32)),
+            T(rng.integers(0, 3, (2, 5, 1))))
+        ml = F.multi_margin_loss(
+            T(rng.normal(size=(4, 5)).astype(np.float32)),
+            T(np.array([0, 1, 2, 3])))
+        npl = F.npair_loss(T(rng.normal(size=(4, 8)).astype(np.float32)),
+                           T(rng.normal(size=(4, 8)).astype(np.float32)),
+                           T(np.array([0, 1, 0, 1])))
+        mce = F.margin_cross_entropy(
+            T(np.clip(rng.normal(size=(4, 10)), -1, 1).astype(np.float32)),
+            T(np.array([1, 2, 3, 4])))
+        hs = F.hsigmoid_loss(T(rng.normal(size=(3, 6)).astype(np.float32)),
+                             T(np.array([0, 3, 7])), 8,
+                             T(rng.normal(size=(7, 6)).astype(np.float32)))
+        for v in (dl, ml, npl, mce):
+            assert np.isfinite(float(v.numpy()))
+        assert hs.shape == [3, 1]
+
+    def test_rnnt_loss_matches_bruteforce(self):
+        import jax
+        import jax.nn as jnn
+
+        logits = rng.normal(size=(1, 2, 2, 3)).astype(np.float32)
+        rl = F.rnnt_loss(T(logits), T(np.array([[1]])), T(np.array([2])),
+                         T(np.array([1])), blank=0, fastemit_lambda=0.0,
+                         reduction="none")
+        lp = np.asarray(jnn.log_softmax(jax.numpy.asarray(logits), axis=-1))
+        # the two monotone lattice paths for T=2, U=1
+        pa = lp[0, 0, 0, 1] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+        pb = lp[0, 0, 0, 0] + lp[0, 1, 0, 1] + lp[0, 1, 1, 0]
+        np.testing.assert_allclose(float(rl.numpy()[0]),
+                                   -np.logaddexp(pa, pb), rtol=1e-4)
+
+    def test_adaptive_log_softmax(self):
+        xa = rng.normal(size=(6, 8)).astype(np.float32)
+        y = np.array([0, 1, 2, 5, 6, 7])
+        hw = rng.normal(size=(8, 5)).astype(np.float32)
+        tails = [(T(rng.normal(size=(8, 2)).astype(np.float32)),
+                  T(rng.normal(size=(2, 4)).astype(np.float32)))]
+        outp, loss = F.adaptive_log_softmax_with_loss(
+            T(xa), T(y), T(hw), tails, [4, 8])
+        assert outp.shape == [6] and np.isfinite(float(loss.numpy()))
+
+    def test_attention_variants(self):
+        qkv = rng.normal(size=(2, 6, 3, 4, 8)).astype(np.float32)
+        o = F.flash_attn_qkvpacked(T(qkv))
+        oo = o[0] if isinstance(o, tuple) else o
+        assert oo.shape == [2, 6, 4, 8]
+
+    def test_sparse_attention_matches_masked_sdpa(self):
+        """Full CSR pattern (all columns) must equal dense attention."""
+        b, h, s, d = 1, 2, 4, 8
+        q = rng.normal(size=(b, h, s, d)).astype(np.float32)
+        k = rng.normal(size=(b, h, s, d)).astype(np.float32)
+        v = rng.normal(size=(b, h, s, d)).astype(np.float32)
+        offset = np.broadcast_to(np.arange(0, (s + 1) * s, s), (b, h, s + 1))
+        cols = np.broadcast_to(np.tile(np.arange(s), s), (b, h, s * s))
+        out = F.sparse_attention(T(q), T(k), T(v),
+                                 T(offset.astype(np.int32)),
+                                 T(cols.astype(np.int32)))
+        import jax.numpy as jnp
+
+        ref = F.scaled_dot_product_attention(
+            T(np.swapaxes(q, 1, 2)), T(np.swapaxes(k, 1, 2)),
+            T(np.swapaxes(v, 1, 2)))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.swapaxes(np.asarray(ref._data), 1, 2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rnnt_fastemit_changes_gradient_not_loss_shape(self):
+        import jax
+
+        logits = rng.normal(size=(1, 2, 2, 3)).astype(np.float32)
+        t0 = T(logits)
+        t0.stop_gradient = False
+        F.rnnt_loss(t0, T(np.array([[1]])), T(np.array([2])),
+                    T(np.array([1])), blank=0,
+                    fastemit_lambda=0.0).backward()
+        g0 = np.asarray(t0.grad._data).copy()
+        t1 = T(logits)
+        t1.stop_gradient = False
+        F.rnnt_loss(t1, T(np.array([[1]])), T(np.array([2])),
+                    T(np.array([1])), blank=0,
+                    fastemit_lambda=0.5).backward()
+        g1 = np.asarray(t1.grad._data)
+        assert not np.allclose(g0, g1)  # the regularizer really applies
+
+    def test_lu_unpack_batched(self):
+        a = rng.normal(size=(3, 4, 4)).astype(np.float32) + \
+            4 * np.eye(4, dtype=np.float32)
+        lu_t, piv = L.lu(T(a))
+        P, Lm, U = L.lu_unpack(lu_t, piv)
+        re = np.asarray(P._data) @ np.asarray(Lm._data) @ np.asarray(U._data)
+        np.testing.assert_allclose(re, a, rtol=1e-4, atol=1e-4)
+
+    def test_fractional_pool_randomness_advances(self):
+        paddle.seed(11)
+        x = T(rng.normal(size=(1, 1, 13, 13)).astype(np.float32))
+        a = np.asarray(F.fractional_max_pool2d(x, output_size=4)._data)
+        outs = [np.asarray(F.fractional_max_pool2d(x, output_size=4)._data)
+                for _ in range(6)]
+        assert any(not np.array_equal(a, o) for o in outs)  # u varies
+        with pytest.raises(NotImplementedError):
+            F.fractional_max_pool2d(x, output_size=4, return_mask=True)
+
+
+class TestLayerAdditions:
+    def test_shape_layers(self):
+        assert nn.Unflatten(1, [2, 3])(
+            T(np.ones((2, 6), np.float32))).shape == [2, 2, 3]
+        assert nn.ZeroPad1D([1, 2])(
+            T(np.ones((1, 2, 4), np.float32))).shape == [1, 2, 7]
+        assert nn.ZeroPad3D([1] * 6)(
+            T(np.ones((1, 1, 2, 2, 2), np.float32))).shape == [1, 1, 4, 4, 4]
+        s2 = nn.Softmax2D()(T(rng.normal(size=(1, 3, 2, 2))
+                              .astype(np.float32)))
+        np.testing.assert_allclose(np.asarray(s2._data).sum(axis=1),
+                                   np.ones((1, 2, 2)), rtol=1e-5)
+
+    def test_loss_layers(self):
+        hs = nn.HSigmoidLoss(6, 8)
+        out = hs(T(rng.normal(size=(3, 6)).astype(np.float32)),
+                 T(np.array([0, 3, 7])))
+        assert out.shape == [3, 1]
+        als = nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 8])
+        o, l = als(T(rng.normal(size=(5, 8)).astype(np.float32)),
+                   T(np.array([0, 3, 5, 9, 11])))
+        assert o.shape == [5] and np.isfinite(float(l.numpy()))
+        lp = als.log_prob(T(rng.normal(size=(2, 8)).astype(np.float32)))
+        np.testing.assert_allclose(np.exp(np.asarray(lp._data)).sum(-1),
+                                   [1, 1], rtol=1e-4)
+
+    def test_beam_search_decode(self):
+        class ToyCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, emb, states):
+                h = (self.lin(emb) + states).tanh()
+                return h, h
+
+        paddle.seed(0)
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=1, end_token=2,
+                                   beam_size=3,
+                                   embedding_fn=nn.Embedding(10, 4),
+                                   output_fn=nn.Linear(4, 10))
+        out, lp = nn.dynamic_decode(dec, T(np.zeros((2, 4), np.float32)),
+                                    max_step_num=6)
+        assert list(out.shape)[:2] == [2, 3]
+        assert np.isfinite(np.asarray(lp._data)).all()
+
+
+class TestSparseAdditions:
+    def test_unary_and_structure(self):
+        import paddle_tpu.sparse as sp
+
+        d = np.array([[0, 0.5, 0], [0.2, 0, 0.8]], np.float32)
+        x = sp.from_dense(T(d))
+        np.testing.assert_allclose(
+            np.asarray(sp.asin(x).to_dense()._data), np.arcsin(d),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sp.expm1(x).to_dense()._data), np.expm1(d),
+            rtol=1e-5)
+        assert abs(float(sp.sum(x).numpy()) - d.sum()) < 1e-6
+        np.testing.assert_allclose(
+            np.asarray(sp.sum(x, axis=1).to_dense()._data), d.sum(1),
+            rtol=1e-6)
+        assert sp.reshape(x, [3, 2]).shape == [3, 2]
+        np.testing.assert_allclose(
+            np.asarray(sp.slice(x, [1], [1], [3]).to_dense()._data),
+            d[:, 1:3])
+        assert sp.is_same_shape(x, T(d))
+        np.testing.assert_allclose(
+            np.asarray(sp.mask_as(T(np.ones((2, 3), np.float32) * 7),
+                                  x).to_dense()._data), (d != 0) * 7.0)
+        np.testing.assert_allclose(
+            np.asarray(sp.mv(x, T(np.array([1., 2, 3],
+                                           np.float32)))._data),
+            d @ [1, 2, 3], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sp.addmm(T(np.ones((2, 2), np.float32)), x,
+                                T(np.ones((3, 2), np.float32)), beta=0.5,
+                                alpha=2.0)._data),
+            0.5 + 2.0 * (d @ np.ones((3, 2))), rtol=1e-6)
+        assert str(sp.cast(x, value_dtype="float64").values()
+                   ._data.dtype) == "float64"
+        assert not bool(np.asarray(sp.isnan(x).to_dense()._data).any())
+
+
+class TestDistributionAdditions:
+    def test_multivariate_normal_vs_scipy(self):
+        from paddle_tpu.distribution import MultivariateNormal
+
+        loc = np.array([1.0, -0.5], np.float32)
+        A = rng.normal(size=(2, 2)).astype(np.float32)
+        cov = A @ A.T + np.eye(2, dtype=np.float32)
+        mvn = MultivariateNormal(T(loc), covariance_matrix=T(cov))
+        v = np.array([0.3, 0.7], np.float32)
+        assert abs(float(mvn.log_prob(T(v)).numpy())
+                   - st.multivariate_normal(loc, cov).logpdf(v)) < 1e-4
+        assert abs(float(mvn.entropy().numpy())
+                   - st.multivariate_normal(loc, cov).entropy()) < 1e-4
+        mvn2 = MultivariateNormal(
+            T(loc * 0), covariance_matrix=T(np.eye(2, dtype=np.float32)))
+        kl_ref = 0.5 * (np.trace(cov) + loc @ loc - 2
+                        - np.log(np.linalg.det(cov)))
+        assert abs(float(mvn.kl_divergence(mvn2).numpy()) - kl_ref) < 1e-3
+
+    def test_continuous_bernoulli_normalized(self):
+        from paddle_tpu.distribution import ContinuousBernoulli
+
+        cb = ContinuousBernoulli(T(np.array([0.3], np.float32)))
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+        lp = np.asarray(cb.log_prob(T(xs[:, None]))._data)[:, 0]
+        assert abs(np.trapezoid(np.exp(lp), xs) - 1) < 1e-2
+        samp = np.asarray(cb.sample([8000])._data)
+        assert abs(samp.mean() - float(cb.mean.numpy()[0])) < 0.02
+
+    def test_lkj_cholesky_valid_correlations(self):
+        from paddle_tpu.distribution import LKJCholesky
+
+        lkj = LKJCholesky(3, 1.5)
+        Lm = np.asarray(lkj.sample([200])._data)
+        corr = Lm @ np.swapaxes(Lm, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-4)
+        assert (np.linalg.eigvalsh(corr) > -1e-5).all()
+        assert np.isfinite(float(lkj.log_prob(T(Lm[0])).numpy()))
+
+
+class TestTensorMethodParity:
+    def test_all_reference_methods_bound(self):
+        from paddle_tpu.tensor_method_names import TENSOR_METHOD_NAMES
+
+        missing = [n for n in TENSOR_METHOD_NAMES
+                   if not hasattr(paddle.Tensor, n)]
+        assert not missing, missing
+
+    def test_new_method_smoke(self):
+        t = T(np.ones((3,), np.float32))
+        t.stop_gradient = True
+        t.uniform_(0.0, 1.0)
+        arr = np.asarray(t._data)
+        assert ((arr >= 0) & (arr < 1)).all()
+        vals, ids = paddle.top_p_sampling(
+            T(rng.normal(size=(2, 10)).astype(np.float32)),
+            T(np.array([0.8, 0.8], np.float32)))
+        assert ids.shape == [2, 1]
+        x = T(np.array([1.0, 2.0], np.float32))
+        x.lerp_(T(np.array([3.0, 4.0], np.float32)), 0.5)
+        np.testing.assert_allclose(np.asarray(x._data), [2.0, 3.0])
