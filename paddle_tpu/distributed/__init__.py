@@ -10,6 +10,8 @@ from .auto_parallel import Engine, Strategy  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from . import fleet, sharding  # noqa: F401
+from . import elastic  # noqa: F401
+from . import rpc  # noqa: F401
 from . import ring_attention  # noqa: F401
 from .ring_attention import ring_flash_attention, ulysses_attention  # noqa: F401
 from .fleet.layers.mpu.mp_ops import split  # noqa: F401

@@ -58,23 +58,41 @@ def _functional_optimizer(opt, named_params=None):
             f"Engine needs an optimizer exposing the pure _update_one hook; "
             f"got {type(opt).__name__}")
     clip = getattr(opt, "_grad_clip", None)
-    clip_norm = None
+    clip_kind = None
+    clip_a = clip_b = None
     if clip is not None:
-        if type(clip).__name__ != "ClipGradByGlobalNorm":
+        clip_kind = type(clip).__name__
+        if clip_kind == "ClipGradByGlobalNorm":
+            clip_a = float(clip.clip_norm)
+        elif clip_kind == "ClipGradByNorm":
+            clip_a = float(clip.clip_norm)
+        elif clip_kind == "ClipGradByValue":
+            clip_a, clip_b = float(clip.min), float(clip.max)
+        else:
             raise NotImplementedError(
-                f"Engine supports ClipGradByGlobalNorm only; got "
-                f"{type(clip).__name__}")
-        clip_norm = float(clip.clip_norm)
+                f"Engine supports ClipGradByGlobalNorm/ByNorm/ByValue; got "
+                f"{clip_kind}")
 
     def _clip_grads(grads):
-        if clip_norm is None:
+        if clip_kind is None:
             return grads
-        sq = jax.tree.reduce(
-            lambda a, g: a + jnp.sum(g.astype(jnp.float32) ** 2),
-            grads, jnp.zeros((), jnp.float32))
-        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
-        return jax.tree.map(lambda g: (g.astype(jnp.float32)
-                                       * scale).astype(g.dtype), grads)
+        if clip_kind == "ClipGradByGlobalNorm":
+            sq = jax.tree.reduce(
+                lambda a, g: a + jnp.sum(g.astype(jnp.float32) ** 2),
+                grads, jnp.zeros((), jnp.float32))
+            scale = jnp.minimum(1.0, clip_a
+                                / jnp.maximum(jnp.sqrt(sq), 1e-12))
+            return jax.tree.map(lambda g: (g.astype(jnp.float32)
+                                           * scale).astype(g.dtype), grads)
+        if clip_kind == "ClipGradByNorm":
+            def per_tensor(g):
+                n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+                s = jnp.minimum(1.0, clip_a / jnp.maximum(n, 1e-12))
+                return (g.astype(jnp.float32) * s).astype(g.dtype)
+
+            return jax.tree.map(per_tensor, grads)
+        return jax.tree.map(  # ClipGradByValue
+            lambda g: jnp.clip(g, clip_a, clip_b).astype(g.dtype), grads)
 
     named_params = named_params or {}
     from ...optimizer.optimizer import _L2DecayLike

@@ -1,0 +1,204 @@
+"""paddle.distributed.rpc analog (reference:
+`python/paddle/distributed/rpc/rpc.py` — init_rpc:85, rpc_sync:160,
+rpc_async:206, shutdown:305, worker infos:336-393).
+
+The reference rides a C++ RPC agent; the TPU-native transport is the same
+coordination-service KV channel the eager p2p layer uses
+(`communication/p2p.py`): a call publishes a pickled (fn, args, kwargs)
+request under a per-callee sequence key, a per-process responder thread
+executes it and publishes the result. Single-controller mode (no
+coordination service) executes calls locally — same API, zero transport.
+
+Scope note: like the reference, functions must be importable on the
+callee (module-level); closures cannot cross processes.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from collections import namedtuple
+from typing import Any, Dict, List, Optional
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+_state: Dict[str, Any] = {"inited": False, "workers": {}, "me": None,
+                          "responder": None, "stop": False}
+
+
+def _client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _multiproc() -> bool:
+    import jax
+
+    return jax.process_count() > 1 and _client() is not None
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None) -> None:
+    """Register this worker and start serving calls (reference rpc.py:85)."""
+    import jax
+
+    if _state["inited"]:
+        raise RuntimeError("rpc is already initialized")
+    rank = jax.process_index() if rank is None else int(rank)
+    world_size = jax.process_count() if world_size is None else int(world_size)
+    me = WorkerInfo(name, rank, "127.0.0.1", 0)
+    _state.update(me=me, inited=True, stop=False)
+    if _multiproc():
+        c = _client()
+        c.key_value_set(f"ptpu_rpc/worker/{rank}",
+                        pickle.dumps(me).hex())
+        # learn every peer (blocking: init_rpc is collective)
+        workers = {}
+        for r in range(world_size):
+            raw = c.blocking_key_value_get(f"ptpu_rpc/worker/{r}", 60_000)
+            w = pickle.loads(bytes.fromhex(raw))
+            workers[w.name] = w
+        _state["workers"] = workers
+        th = threading.Thread(target=_serve_loop, daemon=True)
+        _state["responder"] = th
+        th.start()
+    else:
+        _state["workers"] = {name: me}
+
+
+def _req_key(rank: int, slot: int) -> str:
+    return f"ptpu_rpc/req/{rank}/{slot}"
+
+
+def _resp_key(rank: int, slot: int) -> str:
+    return f"ptpu_rpc/resp/{rank}/{slot}"
+
+
+def _claim_slot(rank: int) -> int:
+    """Atomically claim the next request slot on `rank`'s inbox: the
+    coordination service's key_value_increment gives a total order even
+    with many concurrent callers (no per-caller counters to collide)."""
+    return int(_client().key_value_increment(
+        f"ptpu_rpc/inbox/{rank}", 1)) - 1
+
+
+def _serve_loop():
+    """Responder: process this rank's inbox slots IN ORDER (slot ids are
+    the atomic-counter claims, so the order is total across callers),
+    execute, publish results (the reference's agent server thread)."""
+    c = _client()
+    me = _state["me"]
+    slot = 0
+    while not _state["stop"]:
+        try:
+            raw = c.blocking_key_value_get_bytes(_req_key(me.rank, slot),
+                                                 1000)
+        except Exception:
+            continue  # timeout: poll the stop flag again
+        c.key_value_delete(_req_key(me.rank, slot))
+        try:
+            fn, args, kwargs = pickle.loads(raw)
+            result = ("ok", fn(*args, **kwargs))
+        except Exception as e:  # ship the error to the caller
+            result = ("err", f"{type(e).__name__}: {e}")
+        c.key_value_set_bytes(_resp_key(me.rank, slot),
+                              pickle.dumps(result))
+        slot += 1
+
+
+class _Future:
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._done = False
+        self._value = None
+
+    def wait(self):
+        if not self._done:
+            self._value = self._fetch()
+            self._done = True
+        return self._value
+
+
+def _invoke(to: str, fn, args, kwargs, timeout: float):
+    args = args or ()
+    kwargs = kwargs or {}
+    if not _state["inited"]:
+        raise RuntimeError("call init_rpc first")
+    if not _multiproc():
+        # single-controller: execute NOW (fire-and-forget semantics hold);
+        # errors re-raise at wait(), matching the remote contract
+        try:
+            val = fn(*args, **kwargs)
+
+            def fetch(v=val):
+                return v
+        except Exception as e:
+            def fetch(e=e):
+                raise RuntimeError(
+                    f"rpc to '{to}' failed: {type(e).__name__}: {e}")
+        return _Future(fetch)
+    w = get_worker_info(to)
+    c = _client()
+    slot = _claim_slot(w.rank)
+    c.key_value_set_bytes(_req_key(w.rank, slot),
+                          pickle.dumps((fn, args, kwargs)))
+    tmo_ms = int((timeout if timeout and timeout > 0 else 300) * 1000)
+
+    def fetch():
+        raw = c.blocking_key_value_get_bytes(_resp_key(w.rank, slot),
+                                             tmo_ms)
+        c.key_value_delete(_resp_key(w.rank, slot))
+        status, payload = pickle.loads(raw)
+        if status == "err":
+            raise RuntimeError(f"rpc to '{to}' failed remotely: {payload}")
+        return payload
+
+    return _Future(fetch)
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    """Blocking call on worker `to` (reference rpc.py:160)."""
+    return _invoke(to, fn, args, kwargs, timeout).wait()
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = -1):
+    """Non-blocking call; returns a waitable future (reference rpc.py:206)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def shutdown() -> None:
+    """Block until peers quiesce, stop serving (reference rpc.py:305)."""
+    if not _state["inited"]:
+        return
+    if _multiproc():
+        from jax.experimental import multihost_utils
+
+        # barrier so in-flight calls drain before responders stop
+        multihost_utils.sync_global_devices("ptpu_rpc_shutdown")
+        _state["stop"] = True
+        th = _state["responder"]
+        if th is not None:
+            th.join(timeout=5)
+    _state.update(inited=False, workers={}, me=None, responder=None)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    w = _state["workers"].get(name)
+    if w is None:
+        raise ValueError(f"unknown rpc worker '{name}'")
+    return w
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    if _state["me"] is None:
+        raise RuntimeError("call init_rpc first")
+    return _state["me"]

@@ -431,15 +431,14 @@ def _fractional_pool(x_t, output_size, random_u, ndim, return_mask):
 
     import jax  # noqa: F401  (used inside impl)
 
+    if return_mask:  # fail fast, before any compute is dispatched
+        raise NotImplementedError(
+            "fractional_max_pool(return_mask=True): argmax-mask extraction "
+            "is not implemented on this build; use return_mask=False (the "
+            "mask is only needed for max_unpool round-trips)")
     _reg(f"fractional_max_pool{ndim}d", impl)
-    out = dispatch.apply(f"fractional_max_pool{ndim}d", [x_t],
-                         {"bounds": all_bounds, "ndim": ndim})
-    if not return_mask:
-        return out
-    raise NotImplementedError(
-        "fractional_max_pool(return_mask=True): argmax-mask extraction is "
-        "not implemented on this build; use return_mask=False (the mask is "
-        "only needed for max_unpool round-trips)")
+    return dispatch.apply(f"fractional_max_pool{ndim}d", [x_t],
+                          {"bounds": all_bounds, "ndim": ndim})
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,

@@ -337,11 +337,12 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     (reference tensor/linalg.py:matrix_norm)."""
 
     def impl(x, *, p, axis, keepdim):
-        x = jnp.moveaxis(x, axis, (-2, -1))
-        out = jnp.linalg.norm(x, ord=p, axis=(-2, -1))
-        if keepdim:
-            for a in sorted(axis):
-                out = jnp.expand_dims(out, a)
+        ax = tuple(a % x.ndim for a in axis)
+        moved = jnp.moveaxis(x, ax, (-2, -1))
+        out = jnp.linalg.norm(moved, ord=p, axis=(-2, -1),
+                              keepdims=keepdim)
+        if keepdim:  # put the two kept singleton dims back in place
+            out = jnp.moveaxis(out, (-2, -1), ax)
         return out
 
     _reg("matrix_norm_op", impl)

@@ -1,0 +1,87 @@
+"""Pallas kernel block-size autotuning (reference:
+`paddle/phi/kernels/autotune/auto_tune_base.h` — time candidate configs on
+first use, cache the winner per shape key).
+
+Off by default (`FLAGS_pallas_autotune`): first-call tuning costs one
+compile + a few timed runs per candidate, which only pays off for
+long-running training jobs. When disabled, kernels use their static
+heuristic blocks. Tuning only ever runs on real TPU — interpreter-mode
+timings are meaningless.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, Tuple
+
+from ...framework import flags
+from . import _support
+
+flags.define_flag("pallas_autotune", False,
+                  "time candidate Pallas block configs on first use and "
+                  "cache the fastest")
+
+_cache: Dict[tuple, tuple] = {}
+
+
+def cache_stats():
+    return dict(entries=len(_cache))
+
+
+def clear_cache():
+    _cache.clear()
+
+
+def _time_once(fn: Callable, args, reps: int = 3) -> float:
+    import jax
+
+    out = fn(*args)               # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def pick(kernel_name: str, shape_key: tuple, candidates: Iterable[tuple],
+         builder: Callable[[tuple], Callable], args,
+         default: tuple) -> tuple:
+    """Return the block config to use for (kernel, shape_key).
+
+    `builder(config)` returns a callable running the kernel with that
+    config; candidates that fail to compile are skipped. The winner is
+    cached for the process lifetime (the reference caches per
+    algorithm+shape in AutoTuneCache)."""
+    key = (kernel_name, shape_key)
+    hit = _cache.get(key)
+    if hit is not None:
+        return hit
+    if not flags.flag_value("pallas_autotune") or not _support.on_tpu():
+        _cache[key] = default
+        return default
+    best, best_t = default, float("inf")
+    for cfg in candidates:
+        try:
+            t = _time_once(builder(cfg), args)
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = cfg, t
+    if flags.flag_value("log_compiles"):
+        print(f"[paddle_tpu][autotune] {kernel_name}{shape_key}: "
+              f"picked {best} ({best_t * 1e3:.2f} ms)")
+    _cache[key] = best
+    return best
+
+
+def candidate_blocks(m: int, n: int, k: int) -> Iterable[tuple]:
+    """Matmul-family candidates: powers of two that divide each dim."""
+    def divs(dim, opts):
+        return [b for b in opts if dim % b == 0] or [dim]
+
+    out = []
+    for bm in divs(m, (128, 256, 512)):
+        for bn in divs(n, (256, 512, 1024)):
+            for bk in divs(k, (256, 512, 1024)):
+                out.append((bm, bn, bk))
+    return out[:12]  # bound first-call tuning cost

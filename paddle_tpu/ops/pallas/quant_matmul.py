@@ -71,17 +71,9 @@ def _quant_matmul_bwd_rule(out_dtype, res, g):
 quant_matmul.defvjp(_quant_matmul_fwd_rule, _quant_matmul_bwd_rule)
 
 
-def _quant_matmul_fwd_only(x2d, wq, scale, out_dtype=None):
-    m, k = x2d.shape
-    n, k2 = wq.shape
-    assert k == k2, (x2d.shape, wq.shape)
-    out_dtype = out_dtype or x2d.dtype
-
-    bm = _support.pick_block(m, 256) or m
-    bn = _support.pick_block(n, 512) or n
-    bk = _support.pick_block(k, 512) or k
+def _build_qmm(m, n, k, out_dtype, cfg):
+    bm, bn, bk = cfg
     n_k = pl.cdiv(k, bk)
-
     return _support.pallas_call(
         functools.partial(_qmm_kernel, n_k=n_k),
         grid=(pl.cdiv(m, bm), pl.cdiv(n, bn), n_k),
@@ -97,7 +89,26 @@ def _quant_matmul_fwd_only(x2d, wq, scale, out_dtype=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_support.interpret_mode(),
-    )(x2d, wq, scale)
+    )
+
+
+def _quant_matmul_fwd_only(x2d, wq, scale, out_dtype=None):
+    from . import autotune
+
+    m, k = x2d.shape
+    n, k2 = wq.shape
+    assert k == k2, (x2d.shape, wq.shape)
+    out_dtype = out_dtype or x2d.dtype
+
+    default = (_support.pick_block(m, 256) or m,
+               _support.pick_block(n, 512) or n,
+               _support.pick_block(k, 512) or k)
+    cfg = autotune.pick(
+        "quant_matmul", (m, n, k, str(wq.dtype), str(out_dtype)),
+        autotune.candidate_blocks(m, n, k),
+        lambda c: _build_qmm(m, n, k, out_dtype, c),
+        (x2d, wq, scale), default)
+    return _build_qmm(m, n, k, out_dtype, cfg)(x2d, wq, scale)
 
 
 def supported(x_shape, w_shape, w_dtype) -> bool:

@@ -19,7 +19,7 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
            "AbsmaxObserver", "HistObserver", "FakeQuanterWithAbsMax",
-           "QuantedLinear", "quant_dequant"]
+           "QuantedLinear", "QuantedConv2D", "quant_dequant"]
 
 
 def _arr(x):
@@ -160,6 +160,29 @@ class QuantedLinear(Layer):
         return F.linear(xq, wq, self.linear.bias)
 
 
+class QuantedConv2D(Layer):
+    """Conv2D with fake-quanted weights + activations (reference
+    `nn/quant/quant_layers.py:QuantizedConv2D`)."""
+
+    def __init__(self, conv, q_config: "QuantConfig"):
+        super().__init__()
+        self.conv = conv
+        self.weight_quanter = FakeQuanterWithAbsMax(q_config.weight_bits)
+        self.activation_quanter = FakeQuanterWithAbsMax(
+            q_config.activation_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.activation_quanter(x)
+        wq = self.weight_quanter(self.conv.weight)
+        return F.conv2d(xq, wq, self.conv.bias,
+                        stride=self.conv._stride,
+                        padding=self.conv._padding,
+                        dilation=self.conv._dilation,
+                        groups=self.conv._groups)
+
+
 class quanters:
     FakeQuanterWithAbsMax = FakeQuanterWithAbsMax
 
@@ -191,10 +214,11 @@ class QuantConfig:
 
     def _quantable(self, layer) -> bool:
         from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
 
         if self._types:
             return isinstance(layer, tuple(self._types))
-        return isinstance(layer, Linear)
+        return isinstance(layer, (Linear, Conv2D))
 
 
 def _swap_layers(model: Layer, make):
@@ -218,11 +242,20 @@ class QAT:
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
         target = model if inplace else copy.deepcopy(model)
-        n = _swap_layers(
-            target,
-            lambda l: QuantedLinear(l, self.q_config)
-            if self.q_config._quantable(l)
-            and not isinstance(l, QuantedLinear) else None)
+        def make(l):
+            from ..nn.layer.common import Linear
+            from ..nn.layer.conv import Conv2D
+
+            if isinstance(l, (QuantedLinear, QuantedConv2D)) or \
+                    not self.q_config._quantable(l):
+                return None
+            if isinstance(l, Conv2D):
+                return QuantedConv2D(l, self.q_config)
+            if isinstance(l, Linear):
+                return QuantedLinear(l, self.q_config)
+            return None
+
+        n = _swap_layers(target, make)
         if n == 0:
             raise ValueError("no quantable layers found")
         return target
@@ -251,18 +284,18 @@ class PTQ:
         ptq = self
 
         class _Observed(Layer):
-            def __init__(self, linear):
+            def __init__(self, inner):
                 super().__init__()
-                self.linear = linear
+                self.inner = inner
                 self.act_observer = ptq.observer_cls(
                     ptq.q_config.activation_bits)
                 self.w_observer = ptq.observer_cls(ptq.q_config.weight_bits)
-                self.w_observer.observe(linear.weight)
+                self.w_observer.observe(inner.weight)
                 ptq._observed.append(self)
 
             def forward(self, x):
                 self.act_observer.observe(x)
-                return self.linear(x)
+                return self.inner(x)
 
         n = _swap_layers(
             target,
@@ -287,14 +320,19 @@ class PTQ:
             for name, child in list(getattr(parent, "_sub_layers",
                                             {}).items()):
                 if type(child).__name__ == "_Observed":
-                    lin = child.linear
-                    if deploy_backend is not None:
+                    from ..nn.layer.common import Linear
+
+                    lin = child.inner
+                    if deploy_backend is not None and \
+                            isinstance(lin, Linear):
                         from ..nn.quant import WeightOnlyLinear
 
                         parent._sub_layers[name] = \
                             WeightOnlyLinear.from_linear(
                                 lin, algo=deploy_backend)
                         continue
+                    # non-Linear (e.g. Conv2D) or simulation mode: fold the
+                    # calibrated scale as quant-dequant in place
                     w_scale = child.w_observer.scale()
                     lin.weight._data = _arr(quant_dequant(
                         lin.weight, jnp.asarray(w_scale, jnp.float32),

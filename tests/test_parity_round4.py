@@ -386,3 +386,43 @@ class TestTensorMethodParity:
         x = T(np.array([1.0, 2.0], np.float32))
         x.lerp_(T(np.array([3.0, 4.0], np.float32)), 0.5)
         np.testing.assert_allclose(np.asarray(x._data), [2.0, 3.0])
+
+
+class TestReviewRegressions:
+    def test_matrix_norm_keepdim(self):
+        a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        out = L.matrix_norm(T(a), "fro", axis=(-2, -1), keepdim=True)
+        assert list(out.shape) == [2, 1, 1]
+        np.testing.assert_allclose(
+            np.asarray(out._data)[:, 0, 0],
+            [np.linalg.norm(a[i], "fro") for i in range(2)], rtol=1e-5)
+        out2 = L.matrix_norm(T(a[0]), "fro", keepdim=True)
+        assert list(out2.shape) == [1, 1]
+
+    def test_ptq_handles_conv2d(self):
+        from paddle_tpu.quantization import PTQ, QuantConfig
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Conv2D(2, 3, 3, padding=1), nn.ReLU(),
+                              nn.Linear(3, 4))
+
+        class Wrap(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.body = model
+
+            def forward(self, x):
+                h = self.body[1](self.body[0](x))          # [N,3,H,W]
+                return self.body[2](h.transpose([0, 2, 3, 1]))
+
+        m = Wrap()
+        x = T(rng.normal(size=(2, 2, 4, 4)).astype(np.float32))
+        ptq = PTQ(QuantConfig())
+        obs = ptq.quantize(m)
+        obs(x)
+        # conv folds to quant-dequant simulation, Linear deploys int8
+        dep = ptq.convert(obs, deploy_backend="weight_only_int8")
+        kinds = [type(s).__name__ for s in dep.sublayers()]
+        assert "WeightOnlyLinear" in kinds and "Conv2D" in kinds
+        out = dep(x)
+        assert np.isfinite(np.asarray(out._data)).all()
