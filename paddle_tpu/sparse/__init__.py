@@ -37,6 +37,9 @@ class SparseTensor:
     def __init__(self, data, fmt: str):
         self._data = data      # BCOO or BCSR
         self._fmt = fmt        # "coo" | "csr"
+        # ops producing sparse outputs attach the TAPED values Tensor here
+        # so autograd flows through sparse value pipelines
+        self._values_tensor = None
 
     # -- reference surface ---------------------------------------------
     @property
@@ -56,6 +59,8 @@ class SparseTensor:
         return Tensor(self._data.indices.T)    # [ndim, nnz] like paddle
 
     def values(self) -> Tensor:
+        if self._values_tensor is not None:
+            return self._values_tensor
         return Tensor(self._data.data)
 
     def crows(self) -> Tensor:
@@ -301,15 +306,7 @@ def transpose(x: SparseTensor, perm) -> SparseTensor:
                                              permutation=tuple(perm)))
 
 
-# -- nn sublayer -------------------------------------------------------------
 
-class _SparseReLU:
-    def __call__(self, x):
-        return relu(x)
-
-
-class nn:  # namespace parity: paddle.sparse.nn
-    ReLU = _SparseReLU
 
 
 # ---------------------------------------------------------------------------
@@ -454,3 +451,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
     dense = Tensor(_coo(x).todense()) if isinstance(x, SparseTensor) else x
     return linalg_ops.pca_lowrank(dense, q=q, center=center, niter=niter)
+
+
+# -- nn sublayer (sparse/nn.py module: Conv3D/SubmConv3D/BatchNorm/...) ----
+# imported LAST: nn.py reuses helpers defined throughout this module
+from . import nn  # noqa: E402,F401

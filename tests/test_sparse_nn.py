@@ -1,0 +1,161 @@
+"""paddle.sparse.nn: gather-GEMM-scatter sparse convolution + layers
+(round-3 VERDICT missing-item 5; reference
+`paddle/phi/kernels/sparse/gpu/conv_kernel.cu`, python
+`python/paddle/sparse/nn/`). Numerics checked against a dense correlation
+reference at every occupied site; gradients flow through the dispatch op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+from paddle_tpu.core.tensor import Tensor as T
+
+rng = np.random.default_rng(0)
+
+
+def _sparse_volume(shape=(1, 5, 5, 5, 2), n_sites=10):
+    dense = np.zeros(shape, np.float32)
+    total = shape[1] * shape[2] * shape[3]
+    for s in rng.choice(total, n_sites, replace=False):
+        d = s // (shape[2] * shape[3])
+        h = (s // shape[3]) % shape[2]
+        w = s % shape[3]
+        dense[0, d, h, w] = rng.normal(size=shape[-1])
+    return dense
+
+
+def _dense_conv3d_ref(dense, w, pad_n):
+    kd, kh, kw = w.shape[:3]
+    out = np.zeros(dense.shape[:4] + (w.shape[-1],), np.float32)
+    pad = np.pad(dense, ((0, 0), (pad_n, pad_n), (pad_n, pad_n),
+                         (pad_n, pad_n), (0, 0)))
+    for dd in range(out.shape[1]):
+        for hh in range(out.shape[2]):
+            for ww in range(out.shape[3]):
+                patch = pad[0, dd:dd + kd, hh:hh + kh, ww:ww + kw]
+                out[0, dd, hh, ww] = np.tensordot(
+                    patch, w, axes=([0, 1, 2, 3], [0, 1, 2, 3]))
+    return out
+
+
+class TestSparseConv:
+    def test_conv3d_matches_dense(self):
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 2, 4)).astype(np.float32)
+        b = rng.normal(size=(4,)).astype(np.float32)
+        got = np.asarray(sp.nn.conv3d(x, T(w), T(b), stride=1,
+                                      padding=1).to_dense()._data)
+        ref = _dense_conv3d_ref(dense, w, 1) + b
+        mask = np.abs(got).sum(-1) > 0
+        assert mask.sum() > 0
+        np.testing.assert_allclose(got[mask], ref[mask], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_subm_conv3d_keeps_sites(self):
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 2, 4)).astype(np.float32)
+        out = sp.nn.subm_conv3d(x, T(w), None, stride=1, padding=1)
+        gd = np.asarray(out.to_dense()._data)
+        ref = _dense_conv3d_ref(dense, w, 1)
+        occ = np.abs(dense).sum(-1) > 0
+        np.testing.assert_allclose(gd[occ], ref[occ], rtol=1e-4, atol=1e-4)
+        # output sparsity pattern == input sparsity pattern
+        assert (np.abs(gd).sum(-1) > 0)[~occ].sum() == 0 or \
+            np.allclose(gd[~occ], 0)
+
+    def test_strided_conv_shape(self):
+        dense = _sparse_volume((1, 6, 6, 6, 2), 12)
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(2, 2, 2, 2, 3)).astype(np.float32)
+        out = sp.nn.conv3d(x, T(w), None, stride=2, padding=0)
+        assert out.shape == [1, 3, 3, 3, 3]
+
+    def test_gradients_flow(self):
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        conv = sp.nn.Conv3D(2, 4, 3, padding=1)
+        out = conv(x)
+        out.values().sum().backward()
+        g = conv.weight.grad
+        assert g is not None
+        assert np.isfinite(np.asarray(g._data)).all()
+        assert np.abs(np.asarray(g._data)).max() > 0
+
+    def test_gradients_flow_through_pipeline(self):
+        """conv -> bn -> relu -> pool, loss on pooled values: conv weights
+        receive finite nonzero grads (taped values thread end to end)."""
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        conv = sp.nn.Conv3D(2, 4, 3, padding=1)
+        bn = sp.nn.BatchNorm(4)
+        y = sp.nn.MaxPool3D(2, stride=2)(sp.nn.ReLU()(bn(conv(x))))
+        y.values().sum().backward()
+        g = conv.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g._data)).all()
+        assert np.abs(np.asarray(g._data)).max() > 0
+
+    def test_layer_pipeline(self):
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        conv = sp.nn.Conv3D(2, 4, 3, padding=1)
+        y = sp.nn.MaxPool3D(2, stride=2)(
+            sp.nn.ReLU()(sp.nn.BatchNorm(4)(conv(x))))
+        assert y.shape[:4] == [1, 2, 2, 2]
+        assert y.nnz() > 0
+        vals = np.asarray(y.values()._data)
+        assert (vals >= 0).all()  # relu before pool
+
+    def test_stacked_convs_both_get_grads(self):
+        """Review regression: the tape must thread THROUGH a conv input
+        (x.values() consumed, not a fresh leaf) so earlier layers train."""
+        dense = _sparse_volume()
+        x = sp.from_dense(T(dense))
+        c1 = sp.nn.SubmConv3D(2, 4, 3, padding=1)
+        c2 = sp.nn.SubmConv3D(4, 3, 3, padding=1)
+        out = c2(c1(x))
+        out.values().sum().backward()
+        for layer in (c1, c2):
+            g = layer.weight.grad
+            assert g is not None
+            assert np.abs(np.asarray(g._data)).max() > 0
+
+    def test_pool_values_match_dense_reference(self):
+        """Review regression: pooling must gather values in the SAME order
+        as the rulebook coordinates (conv output is unsorted)."""
+        dense = _sparse_volume((1, 4, 4, 4, 2), 8)
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 2, 3)).astype(np.float32)
+        conv_out = sp.nn.conv3d(x, T(w), None, stride=1, padding=1)
+        pooled = sp.nn.MaxPool3D(2, stride=2)(conv_out)
+        got = np.asarray(pooled.to_dense()._data)
+        ref_conv = _dense_conv3d_ref(dense, w, 1)
+        occupied = np.abs(np.asarray(conv_out.to_dense()._data)
+                          ).sum(-1) > 0
+        masked = np.where(occupied[..., None], ref_conv, -np.inf)
+        ref_pool = masked.reshape(1, 2, 2, 2, 2, 2, 2, 3).max(
+            axis=(2, 4, 6))
+        mask = np.abs(got).sum(-1) > 0
+        np.testing.assert_allclose(got[mask], ref_pool[mask], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_softmax_per_row(self):
+        """Review regression: scalar-valued sparse softmax normalizes PER
+        ROW, not across the whole value vector."""
+        mat = np.array([[1.0, 2.0, 0.0], [0.0, 3.0, 1.0]], np.float32)
+        x = sp.from_dense(T(mat))
+        out = np.asarray(sp.nn.Softmax()(x).to_dense()._data)
+        for r in range(2):
+            nz = mat[r] != 0
+            e = np.exp(mat[r][nz] - mat[r][nz].max())
+            np.testing.assert_allclose(out[r][nz], e / e.sum(), rtol=1e-5)
+
+    def test_conv2d_layer(self):
+        dense = np.zeros((1, 6, 6, 2), np.float32)
+        for s in rng.choice(36, 6, replace=False):
+            dense[0, s // 6, s % 6] = rng.normal(size=2)
+        x = sp.from_dense(T(dense))
+        out = sp.nn.Conv2D(2, 3, 3, padding=1)(x)
+        assert out.shape == [1, 6, 6, 3]
