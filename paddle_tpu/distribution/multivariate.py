@@ -72,12 +72,20 @@ class MultivariateNormal(Distribution):
             cov = jnp.asarray(_arr(covariance_matrix), jnp.float32)
             self._scale_tril = jnp.linalg.cholesky(cov)
         else:
+            from jax.scipy.linalg import solve_triangular
+
             prec = jnp.asarray(_arr(precision_matrix), jnp.float32)
-            chol_p = jnp.linalg.cholesky(prec)
-            eye = jnp.eye(prec.shape[-1], dtype=jnp.float32)
-            # Sigma = P^-1 -> L = (chol(P)^-T) lower-triangularized via solve
-            inv = jnp.linalg.solve(prec, eye)
-            self._scale_tril = jnp.linalg.cholesky(inv)
+            # Sigma = P^-1 with only Cholesky + one triangular solve
+            # (no dense inverse): chol(flip(P)) flipped back is an UPPER
+            # factor U with P = U U^T, so Sigma = U^-T U^-1 and
+            # L = solve_triangular(U^T, I, lower) = U^-T is
+            # lower-triangular with L L^T = Sigma.
+            chol_f = jnp.linalg.cholesky(jnp.flip(prec, (-2, -1)))
+            l_inv = jnp.swapaxes(jnp.flip(chol_f, (-2, -1)), -1, -2)
+            eye = jnp.broadcast_to(
+                jnp.eye(prec.shape[-1], dtype=jnp.float32),
+                l_inv.shape)
+            self._scale_tril = solve_triangular(l_inv, eye, lower=True)
         d = self.loc.shape[-1]
         super().__init__(batch_shape=tuple(np.broadcast_shapes(
             self.loc.shape[:-1], self._scale_tril.shape[:-2])),
@@ -123,8 +131,15 @@ class MultivariateNormal(Distribution):
         v = jnp.asarray(_arr(value), jnp.float32)
         d = self.event_shape[0]
         diff = v - self.loc
+        # broadcast both operands to the common batch shape
+        # (solve_triangular needs matching batch ranks)
+        batch = np.broadcast_shapes(diff.shape[:-1],
+                                    self._scale_tril.shape[:-2])
+        lt = jnp.broadcast_to(self._scale_tril,
+                              batch + self._scale_tril.shape[-2:])
+        diff = jnp.broadcast_to(diff, batch + diff.shape[-1:])
         z = jax.scipy.linalg.solve_triangular(
-            self._scale_tril, diff[..., None], lower=True)[..., 0]
+            lt, diff[..., None], lower=True)[..., 0]
         half_logdet = jnp.sum(jnp.log(jnp.diagonal(
             self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
         return Tensor(-0.5 * jnp.sum(z * z, axis=-1) - half_logdet

@@ -800,8 +800,10 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         if has_bias:
             logits = logits + b[safe][..., 0] if b.ndim == 2 else \
                 logits + b[safe]
-        # BCE with target = 1 - bit (paddle code convention: bit==branch)
-        tgt = 1.0 - bits
+        # BCE with target = path-code bit: the reference kernel computes
+        # sum_j softplus(z_j) - sum_{bit_j=1} z_j (matrix_bit_code Sum,
+        # scale -1), which is exactly BCE(logits, target=bit).
+        tgt = bits
         per = jnp.maximum(logits, 0) - logits * tgt + \
             jnp.log1p(jnp.exp(-jnp.abs(logits)))
         per = jnp.where(valid, per, 0.0)

@@ -208,9 +208,14 @@ def lu(x, pivot=True, get_infos=False, name=None):
     swaps; `lu_unpack` recovers (P, L, U)."""
     lu_packed, pivots = dispatch.apply("lu_op", [as_tensor(x)])
     if get_infos:
-        info = Tensor(jnp.zeros(lu_packed._data.shape[:-2], jnp.int32),
-                      stop_gradient=True)
-        return lu_packed, pivots, info
+        # LAPACK getrf semantics: info = i (1-based) for the first exactly
+        # zero U(i,i) — the factorization completed but U is singular —
+        # else 0. Derived from the packed factor's diagonal per batch.
+        diag = jnp.diagonal(lu_packed._data, axis1=-2, axis2=-1)
+        zero = diag == 0
+        first = jnp.argmax(zero, axis=-1) + 1
+        info = jnp.where(jnp.any(zero, axis=-1), first, 0).astype(jnp.int32)
+        return lu_packed, pivots, Tensor(info, stop_gradient=True)
     return lu_packed, pivots
 
 

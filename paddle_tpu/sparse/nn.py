@@ -51,7 +51,16 @@ def _site_view(x: SparseTensor, ndim: int):
     return np.asarray(coo.indices), vals, coo
 
 
-def _rulebook(coords, dense_spatial, ksize, stride, padding, subm):
+def _out_size(dense_spatial, ksize, stride, padding, dilation):
+    """Dense output extent per spatial dim, with dilated kernel span
+    dilation*(k-1)+1 (reference conv output-size formula)."""
+    return [(dense_spatial[d] + 2 * padding[d]
+             - (dilation[d] * (ksize[d] - 1) + 1)) // stride[d] + 1
+            for d in range(len(ksize))]
+
+
+def _rulebook(coords, dense_spatial, ksize, stride, padding, subm,
+              dilation):
     """Host-side rulebook: for each kernel offset, (in_idx, out_idx) pairs.
 
     coords: [nnz, 1+ndim] int (batch + spatial). Returns
@@ -69,16 +78,15 @@ def _rulebook(coords, dense_spatial, ksize, stride, padding, subm):
     rules = []
     offsets = np.stack(np.meshgrid(
         *[np.arange(k) for k in ksize], indexing="ij"),
-        axis=-1).reshape(-1, ndim)
-    # conv relation: out = (in + pad - off) / stride
+        axis=-1).reshape(-1, ndim) * np.asarray(dilation)
+    out_size = _out_size(dense_spatial, ksize, stride, padding, dilation)
+    # conv relation: out = (in + pad - dilation*off) / stride
     for off in offsets:
         shifted = coords[:, 1:] + np.asarray(padding) - off
         ok = np.ones(nnz, bool)
         for d in range(ndim):
             ok &= (shifted[:, d] % stride[d] == 0)
         out_sp = shifted // np.asarray(stride)
-        out_size = [(dense_spatial[d] + 2 * padding[d] - ksize[d])
-                    // stride[d] + 1 for d in range(ndim)]
         for d in range(ndim):
             ok &= (out_sp[:, d] >= 0) & (out_sp[:, d] < out_size[d])
         in_idx = np.flatnonzero(ok)
@@ -111,16 +119,28 @@ def _rulebook(coords, dense_spatial, ksize, stride, padding, subm):
     return out_coords, rules
 
 
-def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm):
+def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm,
+                 dilation=1, groups=1):
     w_arr = weight._data if isinstance(weight, Tensor) else weight
     ndim = w_arr.ndim - 2
     coords, vals, coo = _site_view(x, ndim)
     dense_shape = tuple(int(s) for s in coo.shape)
     ksize = tuple(int(s) for s in w_arr.shape[:ndim])
     stride, padding = _tup(stride, ndim), _tup(padding, ndim)
+    dilation = _tup(dilation, ndim)
+    groups = int(groups)
+    c_in = int(vals.shape[-1])
+    if c_in % groups or int(w_arr.shape[-1]) % groups:
+        raise ValueError(
+            f"groups={groups} must divide in_channels={c_in} and "
+            f"out_channels={int(w_arr.shape[-1])}")
+    if int(w_arr.shape[-2]) != c_in // groups:
+        raise ValueError(
+            f"kernel expects {int(w_arr.shape[-2])} input channels per "
+            f"group; input has {c_in} channels with groups={groups}")
     spatial = dense_shape[1:1 + ndim]
     out_coords, rules = _rulebook(coords, spatial, ksize, stride, padding,
-                                  subm)
+                                  subm, dilation)
     m = out_coords.shape[0]
     c_out = int(w_arr.shape[-1])
 
@@ -141,18 +161,28 @@ def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm):
 
     opname = f"sparse_conv_{len(rules)}"
 
-    def impl(vals, w, *rest, m, c_out, ndim, has_bias):
+    def impl(vals, w, *rest, m, c_out, ndim, has_bias, groups):
         import jax
         import jax.numpy as jnp
 
         n_off = (len(rest) - (1 if has_bias else 0)) // 2
         out = jnp.zeros((m, c_out), vals.dtype)
-        wk = w.reshape(-1, w.shape[-2], w.shape[-1])  # [n_off, Cin, Cout]
+        wk = w.reshape(-1, w.shape[-2], w.shape[-1])  # [n_off, Cin/g, Cout]
         for t in range(n_off):
             in_idx, out_idx = rest[2 * t], rest[2 * t + 1]
             if in_idx.shape[0] == 0:
                 continue
-            contrib = jnp.take(vals, in_idx, axis=0) @ wk[t]
+            g_in = jnp.take(vals, in_idx, axis=0)
+            if groups == 1:
+                contrib = g_in @ wk[t]
+            else:
+                # group i consumes in-channel slice i, produces out slice i:
+                # block-diagonal GEMM as one einsum so it stays on the MXU
+                n = g_in.shape[0]
+                xg = g_in.reshape(n, groups, -1)
+                wg = wk[t].reshape(wk.shape[1], groups, c_out // groups)
+                contrib = jnp.einsum("ngc,cgo->ngo", xg, wg).reshape(
+                    n, c_out)
             out = out.at[out_idx].add(contrib)
         if has_bias:
             out = out + rest[-1]
@@ -162,10 +192,9 @@ def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm):
         dispatch.register_op(opname, impl)
     out_vals = dispatch.apply(opname, args,
                               {"m": m, "c_out": c_out, "ndim": ndim,
-                               "has_bias": has_bias})
+                               "has_bias": has_bias, "groups": groups})
     out_spatial = spatial if subm else tuple(
-        (spatial[d] + 2 * padding[d] - ksize[d]) // stride[d] + 1
-        for d in range(ndim))
+        _out_size(spatial, ksize, stride, padding, dilation))
     out_shape = (dense_shape[0],) + out_spatial + (c_out,)
     st = sparse_coo_tensor(out_coords.T.tolist(), out_vals,
                            shape=list(out_shape))
@@ -176,13 +205,15 @@ def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm):
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NDHWC", name=None):
     """Sparse 3-D convolution (reference sparse/nn/functional/conv.py)."""
-    return _sparse_conv(x, weight, bias, stride, padding, subm=False)
+    return _sparse_conv(x, weight, bias, stride, padding, subm=False,
+                        dilation=dilation, groups=groups)
 
 
 def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold variant: outputs only at input sites (keeps sparsity)."""
-    return _sparse_conv(x, weight, bias, stride, padding, subm=True)
+    return _sparse_conv(x, weight, bias, stride, padding, subm=True,
+                        dilation=dilation, groups=groups)
 
 
 class _SparseConvBase(Layer):
@@ -197,15 +228,19 @@ class _SparseConvBase(Layer):
         ks = _tup(kernel_size, self._ndim)
         self._stride = stride
         self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
         self.weight = self.create_parameter(
-            list(ks) + [in_channels, out_channels], attr=weight_attr)
+            list(ks) + [in_channels // groups, out_channels],
+            attr=weight_attr)
         self.bias = None if bias_attr is False else self.create_parameter(
             [out_channels], attr=None if bias_attr in (None, True)
             else bias_attr, is_bias=True)
 
     def forward(self, x):
         return _sparse_conv(x, self.weight, self.bias, self._stride,
-                            self._padding, self._subm)
+                            self._padding, self._subm,
+                            dilation=self._dilation, groups=self._groups)
 
 
 class Conv3D(_SparseConvBase):
@@ -357,7 +392,8 @@ class MaxPool3D(Layer):
         coords, vals_t, coo = _site_view(x, 3)
         dense_shape = tuple(int(s) for s in coo.shape)
         out_coords, rules = _rulebook(coords, dense_shape[1:4], self._ks,
-                                      self._stride, self._padding, False)
+                                      self._stride, self._padding, False,
+                                      (1, 1, 1))
         m = out_coords.shape[0]
         all_in = np.concatenate([r[0] for r in rules]) if rules else \
             np.zeros(0, np.int64)
@@ -377,9 +413,9 @@ class MaxPool3D(Layer):
             opname, [gathered, Tensor(np.asarray(all_out, np.int32))],
             {"m": m})
         pooled = pooled_t._data
-        out_spatial = tuple(
-            (dense_shape[1 + d] + 2 * self._padding[d] - self._ks[d])
-            // self._stride[d] + 1 for d in range(3))
+        out_spatial = tuple(_out_size(dense_shape[1:4], self._ks,
+                                      self._stride, self._padding,
+                                      (1, 1, 1)))
         shape = (dense_shape[0],) + out_spatial + (dense_shape[-1],)
         st = sparse_coo_tensor(out_coords.T.tolist(), Tensor(pooled),
                                shape=list(shape))

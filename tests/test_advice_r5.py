@@ -1,0 +1,196 @@
+"""Round-5 advisor-finding regressions (ADVICE.md round 4):
+
+1. hsigmoid_loss must use the path-code BIT as the BCE target (reference
+   kernel: sum softplus(z_j) - sum_{bit_j=1} z_j via matrix_bit_code).
+2. sparse conv must honor dilation and groups (was silently ignored).
+3. lu(get_infos=True) must surface singular factorizations, not zeros.
+4. MultivariateNormal precision path must avoid the dense inverse.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.sparse as sp
+from paddle_tpu.core.tensor import Tensor as T
+
+rng = np.random.default_rng(7)
+
+
+def _softplus(z):
+    return np.maximum(z, 0) + np.log1p(np.exp(-np.abs(z)))
+
+
+class TestHSigmoidTargetConvention:
+    def test_matches_reference_formula_custom_tree(self):
+        """loss = sum_j softplus(z_j) - sum_{bit_j=1} z_j for a
+        user-supplied path_code built with the reference convention."""
+        n, d, num_classes = 4, 5, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=(num_classes - 1, d)).astype(np.float32)
+        b = rng.normal(size=(num_classes - 1,)).astype(np.float32)
+        y = rng.integers(0, num_classes, size=(n,))
+        depth = 3
+        table = rng.integers(0, num_classes - 1,
+                             size=(num_classes, depth)).astype(np.int32)
+        code = rng.integers(0, 2, size=(num_classes, depth)).astype(np.int32)
+        got = np.asarray(F.hsigmoid_loss(
+            T(x), T(y.astype(np.int64)), num_classes, T(w), T(b),
+            path_table=T(table), path_code=T(code))._data)
+        z = np.einsum("nd,nkd->nk", x, w[table[y]]) + b[table[y]]
+        expect = (_softplus(z) - code[y] * z).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+    def test_default_tree_probabilities_normalize(self):
+        num_classes = 8
+        x = rng.normal(size=(1, 4)).astype(np.float32)
+        w = rng.normal(size=(num_classes - 1, 4)).astype(np.float32)
+        losses = []
+        for c in range(num_classes):
+            l = F.hsigmoid_loss(T(x), T(np.array([c], np.int64)),
+                                num_classes, T(w))
+            losses.append(float(np.asarray(l._data).squeeze()))
+        probs = np.exp(-np.asarray(losses))
+        np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-4)
+
+
+def _lax_dense_conv(dense, w, stride, padding, dilation, groups=1):
+    import jax.lax as lax
+
+    ndim = w.ndim - 2
+    dn = lax.conv_dimension_numbers(
+        dense.shape, w.shape,
+        ("NDHWC", "DHWIO", "NDHWC") if ndim == 3 else
+        ("NHWC", "HWIO", "NHWC"))
+    return np.asarray(lax.conv_general_dilated(
+        dense, w, window_strides=(stride,) * ndim,
+        padding=[(padding, padding)] * ndim,
+        rhs_dilation=(dilation,) * ndim, dimension_numbers=dn,
+        feature_group_count=groups))
+
+
+class TestSparseConvDilationGroups:
+    def _volume(self, shape=(1, 7, 7, 7, 4), n_sites=14):
+        dense = np.zeros(shape, np.float32)
+        total = shape[1] * shape[2] * shape[3]
+        for s in rng.choice(total, n_sites, replace=False):
+            dense[0, s // (shape[2] * shape[3]),
+                  (s // shape[3]) % shape[2], s % shape[3]] = \
+                rng.normal(size=shape[-1])
+        return dense
+
+    def test_dilated_conv3d_matches_dense(self):
+        dense = self._volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 4, 2)).astype(np.float32)
+        got = np.asarray(sp.nn.conv3d(x, T(w), None, stride=1, padding=2,
+                                      dilation=2).to_dense()._data)
+        ref = _lax_dense_conv(dense, w, 1, 2, 2)
+        assert got.shape == ref.shape
+        assert np.abs(ref).max() > 0
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_dilated_subm_conv3d_matches_dense_at_sites(self):
+        dense = self._volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 4, 2)).astype(np.float32)
+        got = np.asarray(sp.nn.subm_conv3d(
+            x, T(w), None, stride=1, padding=2,
+            dilation=2).to_dense()._data)
+        ref = _lax_dense_conv(dense, w, 1, 2, 2)
+        occ = np.abs(dense).sum(-1) > 0
+        np.testing.assert_allclose(got[occ], ref[occ], rtol=1e-4, atol=1e-4)
+
+    def test_grouped_conv3d_matches_dense(self):
+        dense = self._volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 2, 4)).astype(np.float32)  # Cin/g=2
+        got = np.asarray(sp.nn.conv3d(x, T(w), None, stride=1, padding=1,
+                                      groups=2).to_dense()._data)
+        ref = _lax_dense_conv(dense, w, 1, 1, 1, groups=2)
+        assert np.abs(ref).max() > 0
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_bad_groups_raises(self):
+        dense = self._volume()
+        x = sp.from_dense(T(dense))
+        w = rng.normal(size=(3, 3, 3, 4, 2)).astype(np.float32)
+        with pytest.raises(ValueError):
+            sp.nn.conv3d(x, T(w), None, groups=3)
+        with pytest.raises(ValueError):
+            # kernel Cin dim inconsistent with groups
+            sp.nn.conv3d(x, T(w), None, groups=2)
+
+    def test_grouped_layer_weight_shape_and_grads(self):
+        dense = self._volume()
+        x = sp.from_dense(T(dense))
+        conv = sp.nn.Conv3D(4, 6, 3, padding=1, groups=2, dilation=2)
+        assert list(conv.weight.shape) == [3, 3, 3, 2, 6]
+        out = conv(x)
+        out.values().sum().backward()
+        g = np.asarray(conv.weight.grad._data)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+class TestLuInfos:
+    def test_singular_matrix_reports_nonzero_info(self):
+        a = np.array([[1.0, 2.0], [2.0, 4.0]], np.float32)  # rank 1
+        _, _, info = paddle.linalg.lu(T(a), get_infos=True)
+        assert int(np.asarray(info._data)) > 0
+
+    def test_nonsingular_matrix_reports_zero(self):
+        a = rng.normal(size=(3, 3)).astype(np.float32) + 3 * np.eye(
+            3, dtype=np.float32)
+        _, _, info = paddle.linalg.lu(T(a), get_infos=True)
+        assert int(np.asarray(info._data)) == 0
+
+    def test_batched_infos(self):
+        good = rng.normal(size=(3, 3)).astype(np.float32) + 3 * np.eye(
+            3, dtype=np.float32)
+        bad = np.zeros((3, 3), np.float32)
+        batch = np.stack([good, bad])
+        _, _, info = paddle.linalg.lu(T(batch), get_infos=True)
+        iv = np.asarray(info._data)
+        assert iv.shape == (2,)
+        assert iv[0] == 0 and iv[1] > 0
+
+
+class TestMVNPrecisionPath:
+    def test_precision_matches_covariance_param(self):
+        from paddle_tpu.distribution import MultivariateNormal
+
+        d = 4
+        a = rng.normal(size=(d, d)).astype(np.float32)
+        cov = a @ a.T + d * np.eye(d, dtype=np.float32)
+        prec = np.linalg.inv(cov).astype(np.float32)
+        loc = rng.normal(size=(d,)).astype(np.float32)
+        mvn_c = MultivariateNormal(T(loc), covariance_matrix=T(cov))
+        mvn_p = MultivariateNormal(T(loc), precision_matrix=T(prec))
+        # scale_tril must be lower triangular with L L^T = P^-1
+        lt = np.asarray(mvn_p.scale_tril._data)
+        np.testing.assert_allclose(lt, np.tril(lt), atol=1e-6)
+        np.testing.assert_allclose(lt @ lt.T, np.linalg.inv(prec),
+                                   rtol=2e-3, atol=2e-3)
+        x = rng.normal(size=(5, d)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(mvn_c.log_prob(T(x))._data),
+                                   np.asarray(mvn_p.log_prob(T(x))._data),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_batched_dist_unbatched_value_log_prob(self):
+        """Regression: batch dims on scale_tril with an unbatched value
+        must broadcast to the common batch shape, not crash."""
+        from scipy import stats
+
+        from paddle_tpu.distribution import MultivariateNormal
+
+        d, b = 3, 4
+        a = rng.normal(size=(b, d, d)).astype(np.float32)
+        cov = a @ np.swapaxes(a, -1, -2) + d * np.eye(d, dtype=np.float32)
+        loc = np.zeros(d, np.float32)
+        mvn = MultivariateNormal(T(loc), covariance_matrix=T(cov))
+        x = rng.normal(size=(d,)).astype(np.float32)
+        lp = np.asarray(mvn.log_prob(T(x))._data)
+        assert lp.shape == (b,)
+        expect = [stats.multivariate_normal(loc, cov[i]).logpdf(x)
+                  for i in range(b)]
+        np.testing.assert_allclose(lp, expect, rtol=2e-3, atol=2e-3)
