@@ -142,6 +142,9 @@ def _tracer_cls():
 def _log_compile(kind, name, key):
     global _compile_count
     _compile_count += 1
+    from ..framework import monitor
+
+    monitor.inc(f"dispatch.compiles.{kind}")
     if flags.flag_value("log_compiles"):
         print(f"[paddle_tpu] compile {kind} op={name}")
 

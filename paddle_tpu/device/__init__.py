@@ -1,0 +1,230 @@
+"""paddle.device — device queries and memory/observability stats.
+
+Reference analogs: `python/paddle/device/__init__.py` plus the CUDA memory
+APIs (`python/paddle/device/cuda/__init__.py`:
+max_memory_allocated/memory_allocated/memory_reserved backed by
+`phi/core/memory/stats.h` Stat<> registries) and the
+`fluid/platform/monitor.h` counter registry (exposed as
+`paddle_tpu.device.monitor`).
+
+TPU mapping: the PJRT runtime owns device memory, so the primary source is
+`jax.Device.memory_stats()` (bytes_in_use / peak_bytes_in_use /
+bytes_limit — populated on real TPU backends). Where the backend reports
+nothing (XLA:CPU), the fallback walks `jax.live_arrays()` and sums the
+bytes of each array's addressable shards per device — exact for framework
+tensors, and the framework keeps a high-water mark sampled at every query
+(and at every eager dispatch while `enable_peak_sampling()` is active) so
+`max_memory_allocated` is meaningful off-TPU too.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..framework import monitor  # noqa: F401  (re-export: device.monitor)
+from ..framework.place import (Place, _get_expected_place, device_count,
+                               get_device, is_compiled_with_cuda,
+                               set_device)
+
+__all__ = ["get_device", "set_device", "device_count", "monitor",
+           "memory_allocated", "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "reset_max_memory_allocated",
+           "reset_peak_memory_stats", "memory_stats",
+           "enable_peak_sampling", "disable_peak_sampling", "empty_cache",
+           "cuda", "is_compiled_with_cuda"]
+
+
+def _resolve(device) -> "object":
+    """Accept None / 'tpu:0' / int ordinal / Place / jax.Device; return a
+    jax.Device."""
+    import jax
+
+    if device is None:
+        return _get_expected_place().jax_device
+    if isinstance(device, Place):
+        return device.jax_device
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        if ":" in device:
+            kind, _, idx = device.partition(":")
+            return Place(kind, int(idx)).jax_device
+        return Place(device, 0).jax_device
+    return device  # assume jax.Device
+
+
+def _live_bytes(dev) -> int:
+    """Exact bytes of live JAX arrays resident on `dev` (fallback
+    accounting when the backend reports no allocator stats)."""
+    import jax
+
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            for sh in a.addressable_shards:
+                if sh.device == dev:
+                    total += int(sh.data.nbytes)
+        except Exception:
+            pass
+    return total
+
+
+# per-device high-water marks for the fallback path, keyed by (platform, id)
+_peaks: Dict[tuple, int] = {}
+_sampling_installed = False
+
+
+def _key(dev) -> tuple:
+    return (dev.platform, dev.id)
+
+
+def _backend_stats(dev) -> Optional[dict]:
+    try:
+        return dev.memory_stats()
+    except Exception:
+        return None
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device
+    (reference `paddle.device.cuda.memory_allocated`)."""
+    dev = _resolve(device)
+    st = _backend_stats(dev)
+    cur = int(st["bytes_in_use"]) if st and "bytes_in_use" in st else \
+        _live_bytes(dev)
+    k = _key(dev)
+    if cur > _peaks.get(k, 0):
+        _peaks[k] = cur
+    return cur
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes (reference
+    `paddle.device.cuda.max_memory_allocated`). On backends without
+    allocator stats this is the high-water mark of sampled queries —
+    sample-at-query plus per-dispatch sampling under
+    `enable_peak_sampling()`."""
+    dev = _resolve(device)
+    st = _backend_stats(dev)
+    if st and "peak_bytes_in_use" in st:
+        return int(st["peak_bytes_in_use"])
+    memory_allocated(dev)  # refresh the mark
+    return _peaks.get(_key(dev), 0)
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the runtime arena (PJRT `bytes_limit` when the
+    backend reports it; otherwise equals allocated)."""
+    dev = _resolve(device)
+    st = _backend_stats(dev)
+    if st:
+        for k in ("bytes_reserved", "pool_bytes", "bytes_limit"):
+            if k in st:
+                return int(st[k])
+    return memory_allocated(dev)
+
+
+def max_memory_reserved(device=None) -> int:
+    return memory_reserved(device)
+
+
+def reset_max_memory_allocated(device=None):
+    """Reset the fallback high-water mark to the current allocation.
+    (Backend-reported peaks are owned by PJRT and cannot be reset.)"""
+    dev = _resolve(device)
+    _peaks[_key(dev)] = _live_bytes(dev)
+
+
+def reset_peak_memory_stats(device=None):
+    reset_max_memory_allocated(device)
+
+
+def memory_stats(device=None) -> dict:
+    """Full stats dict: backend-reported PJRT stats merged with the
+    framework's own accounting (exposed in the profiler summary)."""
+    import jax
+
+    dev = _resolve(device)
+    st = dict(_backend_stats(dev) or {})
+    # one walk over live arrays serves bytes, count, and the peak refresh
+    n_live, live = 0, 0
+    for a in jax.live_arrays():
+        try:
+            here = 0
+            for sh in a.addressable_shards:
+                if sh.device == dev:
+                    here += int(sh.data.nbytes)
+            if here:
+                n_live += 1
+                live += here
+        except Exception:
+            pass
+    cur = int(st.get("bytes_in_use", live))
+    k = _key(dev)
+    if cur > _peaks.get(k, 0):
+        _peaks[k] = cur
+    st.setdefault("bytes_in_use", cur)
+    st.setdefault("peak_bytes_in_use", _peaks.get(k, cur))
+    st["device"] = f"{dev.platform}:{dev.id}"
+    st["num_live_arrays"] = n_live
+    return st
+
+
+def _sample_all(_op_name=None, _outs=None):
+    import jax
+
+    for dev in jax.local_devices():
+        st = _backend_stats(dev)
+        cur = int(st["bytes_in_use"]) if st and "bytes_in_use" in st \
+            else _live_bytes(dev)
+        k = _key(dev)
+        if cur > _peaks.get(k, 0):
+            _peaks[k] = cur
+
+
+def enable_peak_sampling():
+    """Sample every eager dispatch into the high-water mark (off by
+    default: the walk over live arrays is O(arrays) per op). Used by the
+    profiler's profile_memory mode and the auto-tuner's trial runner."""
+    global _sampling_installed
+    if not _sampling_installed:
+        from ..core import dispatch
+
+        dispatch.add_op_observer(_sample_all)
+        _sampling_installed = True
+
+
+def disable_peak_sampling():
+    global _sampling_installed
+    if _sampling_installed:
+        from ..core import dispatch
+
+        dispatch.remove_op_observer(_sample_all)
+        _sampling_installed = False
+
+
+def empty_cache():
+    """Release framework-held executable caches and drop dead buffers
+    (PJRT frees device memory when the last reference dies; this triggers
+    collection so it happens now)."""
+    import gc
+
+    gc.collect()
+
+
+class _CudaNamespace:
+    """`paddle.device.cuda` compatibility facade mapping onto the same
+    stats (the reference exposes the memory API under device.cuda)."""
+
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+
+cuda = _CudaNamespace()

@@ -1,0 +1,88 @@
+"""Global counter registry (reference `fluid/platform/monitor.h`:
+DEFINE_INT_STATUS / StatRegistry).
+
+The reference exposes process-wide named integer counters that subsystems
+bump (dataloader queue depths, RPC bytes, allocator events) and tooling
+scrapes. TPU-native equivalent: a plain Python registry; the PJRT runtime
+owns device allocation, so the built-in counters here track what the
+framework itself does (executable compiles, eager dispatches), and any
+subsystem can register its own.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["register_counter", "counter", "inc", "set_value", "get",
+           "get_all", "reset", "reset_all", "Counter"]
+
+
+class Counter:
+    """One named monotonic/settable counter (int or float)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, delta=1):
+        with self._lock:
+            self._value += delta
+        return self._value
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    def get(self):
+        return self._value
+
+    def reset(self):
+        self.set(0)
+
+
+_registry: Dict[str, Counter] = {}
+_registry_lock = threading.Lock()
+
+
+def register_counter(name: str) -> Counter:
+    """Idempotently register (or fetch) a counter by name."""
+    with _registry_lock:
+        c = _registry.get(name)
+        if c is None:
+            c = _registry[name] = Counter(name)
+        return c
+
+
+def counter(name: str) -> Counter:
+    return register_counter(name)
+
+
+def inc(name: str, delta=1):
+    return register_counter(name).inc(delta)
+
+
+def set_value(name: str, value):
+    register_counter(name).set(value)
+
+
+def get(name: str):
+    c = _registry.get(name)
+    return 0 if c is None else c.get()
+
+
+def get_all() -> Dict[str, object]:
+    return {k: c.get() for k, c in sorted(_registry.items())}
+
+
+def reset(name: str):
+    c = _registry.get(name)
+    if c is not None:
+        c.reset()
+
+
+def reset_all():
+    for c in _registry.values():
+        c.reset()

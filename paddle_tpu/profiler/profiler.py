@@ -188,6 +188,7 @@ class Profiler:
             self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        self._profile_memory = bool(profile_memory)
         self._targets = set(targets or [ProfilerTarget.CPU,
                                         ProfilerTarget.TPU])
         self._device_trace = any(t != ProfilerTarget.CPU
@@ -214,6 +215,10 @@ class Profiler:
         rec = self.recorder
         dispatch.set_profile_hook(
             lambda name, t0, t1: rec.add(name, t0, t1, "op"))
+        if self._profile_memory:
+            from .. import device as dev_api
+
+            dev_api.enable_peak_sampling()
         if self._device_trace and not self._device_tracing:
             try:
                 import jax
@@ -231,6 +236,10 @@ class Profiler:
 
         dispatch.set_profile_hook(None)
         _active_recorder = None
+        if self._profile_memory:
+            from .. import device as dev_api
+
+            dev_api.disable_peak_sampling()
         if self._device_tracing:
             try:
                 import jax
@@ -340,4 +349,18 @@ class Profiler:
         for name, (tot, cnt, mx) in rows:
             lines.append(f"{name[:39]:<40}{cnt:>8}{tot * unit:>14.3f}"
                          f"{tot / cnt * unit:>12.3f}{mx * unit:>12.3f}")
+        if self._profile_memory:
+            from .. import device as dev_api
+
+            st = dev_api.memory_stats()
+            lines.append("")
+            lines.append(
+                f"Device memory [{st['device']}]: "
+                f"allocated={st['bytes_in_use'] / 1e6:.2f} MB, "
+                f"peak={st['peak_bytes_in_use'] / 1e6:.2f} MB, "
+                f"live_arrays={st['num_live_arrays']}")
+            counters = dev_api.monitor.get_all()
+            if counters:
+                lines.append("Monitor counters: " + ", ".join(
+                    f"{k}={v}" for k, v in counters.items()))
         return "\n".join(lines)

@@ -1,0 +1,138 @@
+"""paddle.device memory stats + monitor counter registry (round-5 VERDICT
+item 7; reference `python/paddle/device/cuda/__init__.py` memory APIs over
+`phi/core/memory/stats.h`, and `fluid/platform/monitor.h`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+from paddle_tpu.core.tensor import Tensor as T
+from paddle_tpu.framework import monitor
+
+
+class TestMemoryStats:
+    def test_allocated_tracks_new_buffers(self):
+        base = device.memory_allocated()
+        big = T(np.ones((512, 1024), np.float32))  # 2 MB
+        _ = big._data.block_until_ready() if hasattr(
+            big._data, "block_until_ready") else None
+        after = device.memory_allocated()
+        assert after - base >= 2 * 1024 * 1024 * 0.9
+        del big
+
+    def test_peak_survives_deletion(self):
+        device.reset_max_memory_allocated()
+        big = T(np.ones((1024, 1024), np.float32))  # 4 MB
+        device.memory_allocated()  # sample while alive
+        del big
+        import gc
+
+        gc.collect()
+        peak = device.max_memory_allocated()
+        cur = device.memory_allocated()
+        assert peak >= cur
+        assert peak - cur >= 4 * 1024 * 1024 * 0.5
+
+    def test_reset_peak(self):
+        import pytest
+
+        if device._backend_stats(device._resolve(None)):
+            pytest.skip("backend reports PJRT peaks; fallback reset n/a")
+        big = T(np.ones((1024, 1024), np.float32))
+        device.memory_allocated()
+        del big
+        import gc
+
+        gc.collect()
+        device.reset_max_memory_allocated()
+        assert device.max_memory_allocated() == device.memory_allocated()
+
+    def test_memory_stats_dict(self):
+        st = device.memory_stats()
+        assert "bytes_in_use" in st and "peak_bytes_in_use" in st
+        assert "device" in st and st["num_live_arrays"] >= 0
+
+    def test_device_arg_forms(self):
+        a = device.memory_allocated(None)
+        b = device.memory_allocated(0)
+        c = device.cuda.memory_allocated()
+        assert a >= 0 and b >= 0 and c >= 0
+
+    def test_reserved_nonnegative(self):
+        assert device.memory_reserved() >= 0
+        assert device.max_memory_reserved() >= 0
+
+
+class TestShardedAccounting:
+    def test_sharded_array_bytes_split_across_devices(self):
+        """The per-device accounting must see only the LOCAL shard bytes
+        of a GSPMD-sharded array (the allocator-grounded measurement the
+        ZeRO stage tests' fraction checks model)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        import pytest
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs the 8-device virtual CPU mesh")
+        mesh = Mesh(np.array(devs[:8]), ("x",))
+        before = [device.memory_allocated(d) for d in devs[:8]]
+        arr = jax.device_put(jnp.ones((8 * 1024, 128), jnp.float32),
+                             NamedSharding(mesh, P("x", None)))  # 4 MB
+        arr.block_until_ready()
+        after = [device.memory_allocated(d) for d in devs[:8]]
+        deltas = [a - b for a, b in zip(after, before)]
+        shard = 4 * 1024 * 1024 // 8
+        for d in deltas:
+            assert shard * 0.9 <= d <= shard * 3, deltas
+        del arr
+
+
+class TestMonitor:
+    def test_counter_register_inc_get(self):
+        monitor.register_counter("test.ctr")
+        monitor.inc("test.ctr")
+        monitor.inc("test.ctr", 4)
+        assert monitor.get("test.ctr") == 5
+        monitor.reset("test.ctr")
+        assert monitor.get("test.ctr") == 0
+
+    def test_get_all_contains_registered(self):
+        monitor.inc("test.other", 2)
+        allc = monitor.get_all()
+        assert allc["test.other"] == 2
+
+    def test_dispatch_compiles_counted(self):
+        before = monitor.get("dispatch.compiles.fwd")
+        # a unique fresh shape forces exactly one fwd compile
+        x = T(np.ones((3, 1717), np.float32))
+        y = T(np.ones((3, 1717), np.float32))
+        _ = x + y
+        assert monitor.get("dispatch.compiles.fwd") == before + 1
+
+    def test_unknown_counter_reads_zero(self):
+        assert monitor.get("never.registered") == 0
+
+
+class TestProfilerMemoryIntegration:
+    def test_summary_includes_memory_section(self):
+        import paddle_tpu.profiler as profiler
+
+        with profiler.Profiler(profile_memory=True) as p:
+            x = T(np.ones((64, 64), np.float32))
+            (x @ x).sum()
+            p.step()
+        text = p.summary()
+        assert "Device memory" in text
+        assert "peak=" in text
+
+    def test_peak_sampling_observer_removed_after_stop(self):
+        from paddle_tpu.core import dispatch
+
+        import paddle_tpu.profiler as profiler
+
+        n_before = len(dispatch._op_observers)
+        with profiler.Profiler(profile_memory=True):
+            pass
+        assert len(dispatch._op_observers) == n_before
