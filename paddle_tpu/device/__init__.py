@@ -172,10 +172,25 @@ def memory_stats(device=None) -> dict:
 def _sample_all(_op_name=None, _outs=None):
     import jax
 
-    for dev in jax.local_devices():
+    devs = jax.local_devices()
+    with_stats = []
+    fallback = {}
+    for dev in devs:
         st = _backend_stats(dev)
-        cur = int(st["bytes_in_use"]) if st and "bytes_in_use" in st \
-            else _live_bytes(dev)
+        if st and "bytes_in_use" in st:
+            with_stats.append((dev, int(st["bytes_in_use"])))
+        else:
+            fallback[dev] = 0
+    if fallback:
+        # one pass over live arrays, accumulated per device
+        for a in jax.live_arrays():
+            try:
+                for sh in a.addressable_shards:
+                    if sh.device in fallback:
+                        fallback[sh.device] += int(sh.data.nbytes)
+            except Exception:
+                pass
+    for dev, cur in with_stats + list(fallback.items()):
         k = _key(dev)
         if cur > _peaks.get(k, 0):
             _peaks[k] = cur

@@ -1,0 +1,95 @@
+"""Analytical per-device memory cost model for parallel-config pruning.
+
+Reference `python/paddle/distributed/auto_tuner/` prunes candidate
+(dp, mp, pp, mbs) configs with a memory cost model before launching trial
+jobs (`tuner.py`, `memory_cost_model.py` — estimates param + grad +
+optimizer-state + activation bytes per rank and drops configs over the
+device limit). TPU version of the same arithmetic for the llama-style
+decoder the trial runner uses.
+
+All byte counts are fp32 (the trial runner trains in fp32 on the virtual
+CPU mesh; on real TPU pass ``bytes_per_param=2`` for bf16).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+__all__ = ["transformer_param_count", "estimate_bytes_per_device",
+           "prune_by_memory"]
+
+
+def transformer_param_count(model_cfg: Dict) -> int:
+    """Parameter count of the llama-style decoder
+    (`models/llama.py`): embed + L * (attn 4h^2 + mlp 3*h*ffn + 2 norms)
+    + final norm + lm_head."""
+    h = int(model_cfg["hidden_size"])
+    L = int(model_cfg["num_layers"])
+    v = int(model_cfg["vocab_size"])
+    # llama_tiny (what the trial runner trains) uses intermediate = 3h
+    ffn = int(model_cfg.get("intermediate_size", 3 * h))
+    per_layer = 4 * h * h + 3 * h * ffn + 2 * h
+    return v * h + L * per_layer + h + h * v
+
+
+def estimate_bytes_per_device(cfg: Dict, model_cfg: Dict, *,
+                              seq_len: int, bytes_per_param: int = 4,
+                              optimizer_states: int = 2,
+                              remat: bool = False) -> int:
+    """Estimated peak bytes on one device for a candidate config.
+
+    - params / grads: sharded over mp (tensor parallel) and pp (layer
+      split); dp replicates.
+    - optimizer states (Adam m+v): shard like params, further divided by
+      the sharding degree when ZeRO is on (cfg['sharding_degree']).
+    - activations: mbs * seq * h per layer-on-this-stage, with the
+      standard transformer multiplier (~14 tensors/layer without remat,
+      ~2 with remat: boundaries only), divided by mp (TP splits the wide
+      activations).
+    """
+    h = int(model_cfg["hidden_size"])
+    L = int(model_cfg["num_layers"])
+    mp = int(cfg.get("mp_degree", 1))
+    pp = int(cfg.get("pp_degree", 1))
+    mbs = int(cfg.get("micro_batch_size", 1))
+    shard = int(cfg.get("sharding_degree", 1))
+
+    n_params = transformer_param_count(model_cfg)
+    params_local = n_params / (mp * pp)
+    param_bytes = params_local * bytes_per_param
+    grad_bytes = params_local * bytes_per_param
+    opt_bytes = params_local * bytes_per_param * optimizer_states / shard
+
+    act_mult = 2 if remat else 14
+    layers_here = max(1, L // pp)
+    act_bytes = (mbs * seq_len * h * layers_here * act_mult
+                 * bytes_per_param / mp)
+    # pipeline keeps up to S in-flight micro-batches of boundary
+    # activations
+    if pp > 1:
+        act_bytes += mbs * seq_len * h * pp * bytes_per_param
+    return int(param_bytes + grad_bytes + opt_bytes + act_bytes)
+
+
+def prune_by_memory(candidates: List[Dict], tuner_cfg: Dict
+                    ) -> Tuple[List[Dict], List[Dict]]:
+    """Split candidates into (runnable, pruned) under
+    tuner_cfg['memory_limit_bytes']. Pruned entries carry the estimate and
+    reason (the reference records these as pruned trials)."""
+    limit = tuner_cfg.get("memory_limit_bytes")
+    model_cfg = tuner_cfg.get("model", {})
+    seq = int(tuner_cfg.get("seq_len", model_cfg.get("seq_len", 128)))
+    if not limit or not model_cfg:
+        return list(candidates), []
+    keep, pruned = [], []
+    for c in candidates:
+        est = estimate_bytes_per_device(
+            c, model_cfg, seq_len=seq,
+            bytes_per_param=int(tuner_cfg.get("bytes_per_param", 4)),
+            remat=bool(tuner_cfg.get("use_recompute", False)))
+        if est > limit:
+            pruned.append({**c, "estimated_bytes": est,
+                           "error": f"pruned: modelled memory {est} > "
+                                    f"limit {limit}"})
+        else:
+            keep.append({**c, "estimated_bytes": est})
+    return keep, pruned

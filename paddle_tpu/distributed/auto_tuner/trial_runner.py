@@ -1,0 +1,228 @@
+"""Subprocess trial job for the auto-tuner.
+
+Reference `python/paddle/distributed/auto_tuner/tuner.py` launches each
+candidate config as a real distributed job and scrapes tok/s + memory from
+its logs. TPU version: one fresh process per trial, forced onto an
+n-device virtual CPU mesh, that trains a tiny llama under the candidate's
+(dp, mp, pp, micro_batch_size) layout for a few global batches and prints
+ONE JSON line: {"tok_per_sec", "global_batch_time", "peak_mem_bytes",
+"error"}.
+
+Layout mapping per candidate:
+- dp/mp: GSPMD over a ("dp", "mp") mesh — batch over dp, Megatron TP
+  placements over mp (same placements as `__graft_entry__._param_spec`).
+- pp > 1: the compiled `scan_pipeline` path over a pp-axis mesh (layer
+  stack split into stages, boundary activations `ppermute`d around the
+  ring). Composing pp with dp/mp in one trial process is not supported —
+  those candidates report a structured error and the tuner records them
+  as failed trials (the reference likewise records infeasible launches).
+
+Run: ``python -m paddle_tpu.distributed.auto_tuner.trial_runner '<json>'``
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _force_cpu(n_devices: int) -> None:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+
+def _param_spec(name: str, P):
+    """Megatron TP placements (mirrors `__graft_entry__._param_spec`)."""
+    col = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head")
+    row = ("o_proj", "down_proj")
+    if "embed_tokens" in name:
+        return P("mp", None)
+    if any(k in name for k in col):
+        return P(None, "mp")
+    if any(k in name for k in row):
+        return P("mp", None)
+    return P()
+
+
+def _run_dp_mp(cfg, model_cfg, seq, steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import functional_call, state_arrays
+    from paddle_tpu.models import llama_tiny
+
+    dp, mp = int(cfg["dp_degree"]), int(cfg["mp_degree"])
+    mbs = int(cfg["micro_batch_size"])
+    gb = int(cfg.get("global_batch_size", dp * mbs))
+    n_micro = max(1, gb // (dp * mbs))
+
+    devs = jax.devices()[: dp * mp]
+    mesh = Mesh(np.asarray(devs).reshape(dp, mp), ("dp", "mp"))
+    model = llama_tiny(vocab=int(model_cfg["vocab_size"]),
+                       layers=int(model_cfg["num_layers"]),
+                       hidden=int(model_cfg["hidden_size"]),
+                       heads=int(model_cfg["num_heads"]), seq=seq)
+    model.train()
+    params = state_arrays(model)
+    specs = {k: _param_spec(k, P) for k in params}
+    put = lambda t, s: jax.device_put(t, NamedSharding(mesh, s))
+    params = {k: put(v, specs[k]) for k, v in params.items()}
+    grads0 = {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    def loss_fn(p, ids, labels):
+        loss, _ = functional_call(model, p, Tensor(ids),
+                                  labels=Tensor(labels))
+        return loss._data
+
+    def micro_grad(p, ids, labels):
+        return jax.grad(loss_fn)(p, ids, labels)
+
+    def apply(p, g, lr=1e-3):
+        return jax.tree.map(lambda w, gw: w - lr * gw, p, g)
+
+    rng = np.random.default_rng(0)
+    data_spec = NamedSharding(mesh, P("dp", None))
+    micro_ids = [
+        jax.device_put(
+            jnp.asarray(rng.integers(
+                0, model_cfg["vocab_size"], (dp * mbs, seq))), data_spec)
+        for _ in range(n_micro)]
+
+    jit_grad = jax.jit(micro_grad)
+    jit_apply = jax.jit(apply)
+
+    def global_batch():
+        acc = grads0
+        for ids in micro_ids:
+            g = jit_grad(params, ids, ids)
+            acc = jax.tree.map(jnp.add, acc, g)
+        return jit_apply(params, acc)
+
+    from paddle_tpu import device
+
+    params = global_batch()  # warmup/compile
+    jax.block_until_ready(jax.tree.leaves(params))
+    device._sample_all()  # record peaks while buffers are live
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params = global_batch()
+    jax.block_until_ready(jax.tree.leaves(params))
+    dt = (time.perf_counter() - t0) / steps
+    device._sample_all()
+    return gb * seq / dt, dt
+
+
+def _run_pp(cfg, model_cfg, seq, steps):
+    """Pure pipeline trial: the decoder layer stack over the pp axis via
+    the compiled scan_pipeline; embed/head run replicated outside."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        scan_pipeline)
+
+    pp = int(cfg["pp_degree"])
+    mbs = int(cfg["micro_batch_size"])
+    gb = int(cfg.get("global_batch_size", mbs))
+    n_micro = max(1, gb // mbs)
+    h = int(model_cfg["hidden_size"])
+    L = int(model_cfg["num_layers"])
+    if L % pp:
+        raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+
+    devs = jax.devices()[:pp]
+    mesh = Mesh(np.asarray(devs), ("pp",))
+    rng = np.random.default_rng(0)
+    # homogeneous MLP-block stages standing in for the decoder stack
+    # (x -> x + tanh(x W1) W2), layers/pp blocks per stage
+    lp = L // pp
+    W1 = jnp.asarray(rng.standard_normal((pp, lp, h, 3 * h)) * 0.02,
+                     jnp.float32)
+    W2 = jnp.asarray(rng.standard_normal((pp, lp, 3 * h, h)) * 0.02,
+                     jnp.float32)
+
+    def stage_fn(p, x):
+        # scan_pipeline already dropped the stage dim: leaves [lp, h, 3h]
+        w1, w2 = p["w1"], p["w2"]
+        for i in range(lp):
+            x = x + jnp.tanh(x @ w1[i]) @ w2[i]
+        return x
+
+    xs = jnp.asarray(rng.standard_normal((n_micro, mbs * seq, h)),
+                     jnp.float32)
+
+    def run(xs):
+        with mesh:
+            return scan_pipeline(stage_fn, {"w1": W1, "w2": W2}, xs,
+                                 n_micro, axis_name="pp", mesh=mesh)
+
+    from paddle_tpu import device
+
+    out = run(xs)
+    jax.block_until_ready(out)
+    device._sample_all()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run(xs)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / steps
+    device._sample_all()
+    return gb * seq / dt, dt
+
+
+def run_trial(cfg: dict) -> dict:
+    n = int(cfg.get("num_devices", 8))
+    _force_cpu(n)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import device
+
+    model_cfg = cfg.get("model") or {
+        "vocab_size": 128, "num_layers": 2, "hidden_size": 64,
+        "num_heads": 4}
+    seq = int(cfg.get("seq_len", 32))
+    steps = int(cfg.get("timing_steps", 2))
+    dp, mp, pp = (int(cfg.get(k, 1)) for k in
+                  ("dp_degree", "mp_degree", "pp_degree"))
+    if pp == 1:
+        toks, dt = _run_dp_mp(cfg, model_cfg, seq, steps)
+    elif dp == 1 and mp == 1:
+        toks, dt = _run_pp(cfg, model_cfg, seq, steps)
+    else:
+        raise NotImplementedError(
+            f"trial layout dp={dp} mp={mp} pp={pp}: pp composes with "
+            "dp/mp only through the Engine, not the trial runner")
+    peak = max(device.max_memory_allocated(d) for d in jax.devices()[:n])
+    return {"tok_per_sec": round(toks, 1),
+            "global_batch_time": round(dt, 4),
+            "peak_mem_bytes": int(peak), "error": None}
+
+
+def main(argv):
+    cfg = json.loads(argv[1])
+    try:
+        out = run_trial(cfg)
+    except Exception as e:  # structured failure for the tuner
+        out = {"tok_per_sec": 0.0, "global_batch_time": float("inf"),
+               "peak_mem_bytes": 0,
+               "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

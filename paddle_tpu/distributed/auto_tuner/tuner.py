@@ -7,7 +7,61 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["AutoTuner", "Recorder", "gen_candidates", "prune_candidates"]
+__all__ = ["AutoTuner", "Recorder", "gen_candidates", "prune_candidates",
+           "subprocess_trial_fn"]
+
+
+def subprocess_trial_fn(tuner_cfg: Dict,
+                        timeout: float = 300.0) -> Callable[[Dict], Dict]:
+    """Trial function that launches each candidate as a REAL subprocess
+    job on a virtual n-device CPU mesh (reference `tuner.py` launches
+    distributed trial jobs and scrapes metrics from their logs).
+
+    The child process (`trial_runner.py`) trains a tiny llama under the
+    candidate layout and prints one JSON line with tok/s + peak memory
+    (from `paddle_tpu.device.max_memory_allocated`)."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    import paddle_tpu
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+
+    def fn(cfg: Dict) -> Dict:
+        full = {**cfg,
+                "num_devices": tuner_cfg.get("num_devices", 8),
+                "model": tuner_cfg.get("model"),
+                "seq_len": tuner_cfg.get("seq_len", 32),
+                "global_batch_size": tuner_cfg.get("global_batch_size"),
+                "timing_steps": tuner_cfg.get("timing_steps", 2)}
+        # absent keys must stay absent so the child applies its defaults
+        full = {k: v for k, v in full.items() if v is not None}
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # child sets its own device count
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root, env.get("PYTHONPATH", "")])
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.auto_tuner.trial_runner",
+             _json.dumps(full)],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+        try:
+            res = _json.loads(lines[-1])
+        except (IndexError, ValueError):
+            raise RuntimeError(
+                f"trial produced no result (rc={proc.returncode}): "
+                f"{proc.stderr[-300:]}")
+        if res.get("error"):
+            raise RuntimeError(res["error"])
+        res["step_time"] = res["global_batch_time"]
+        return res
+
+    return fn
 
 
 def _divisors(n: int) -> List[int]:
@@ -40,7 +94,11 @@ def prune_candidates(candidates: List[Dict], tuner_cfg: Dict) -> List[Dict]:
     must divide the model's layer count, micro-batch must divide the
     per-dp batch."""
     n = int(tuner_cfg.get("num_devices", 1))
-    layers = int(tuner_cfg.get("num_layers", 0))
+    # the pp-divisibility check must use the SAME layer count the trial
+    # runs with: fall back to the model config's num_layers
+    layers = int(tuner_cfg.get("num_layers",
+                               (tuner_cfg.get("model") or {})
+                               .get("num_layers", 0)))
     batch = int(tuner_cfg.get("global_batch_size", 1))
     keep = []
     for c in candidates:
@@ -100,7 +158,20 @@ class AutoTuner:
             metric=tuner_cfg.get("metric", "step_time"),
             maximize=bool(tuner_cfg.get("maximize", False)))
         cands = gen_candidates(self.tuner_cfg)
-        self.candidates = prune_candidates(cands, self.tuner_cfg)
+        cands = prune_candidates(cands, self.tuner_cfg)
+        # memory-cost-model pruning (reference memory_cost_model.py):
+        # infeasible configs are recorded as pruned trials, not launched
+        from .memory_model import prune_by_memory
+
+        self.candidates, self.pruned = prune_by_memory(cands,
+                                                       self.tuner_cfg)
+        for p in self.pruned:
+            self.recorder.add({k: p[k] for k in
+                               ("dp_degree", "mp_degree", "pp_degree",
+                                "micro_batch_size")},
+                              {self.recorder.metric: float("inf"),
+                               "error": p["error"],
+                               "estimated_bytes": p["estimated_bytes"]})
         self._cur = 0
 
     def has_next(self) -> bool:
@@ -114,7 +185,13 @@ class AutoTuner:
         return cfg
 
     def tune(self, max_trials: Optional[int] = None) -> Optional[Dict]:
-        """Run trials through trial_fn; returns the best config."""
+        """Run trials through trial_fn; returns the best config. With
+        ``tuner_cfg['launch_trials']`` set and no explicit trial_fn,
+        candidates run as real subprocess jobs (subprocess_trial_fn)."""
+        if self.trial_fn is None and self.tuner_cfg.get("launch_trials"):
+            self.trial_fn = subprocess_trial_fn(
+                self.tuner_cfg,
+                timeout=float(self.tuner_cfg.get("trial_timeout", 300)))
         if self.trial_fn is None:
             raise ValueError("pass trial_fn to tune()")
         n = 0

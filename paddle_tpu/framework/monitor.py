@@ -74,7 +74,9 @@ def get(name: str):
 
 
 def get_all() -> Dict[str, object]:
-    return {k: c.get() for k, c in sorted(_registry.items())}
+    with _registry_lock:
+        items = sorted(_registry.items())
+    return {k: c.get() for k, c in items}
 
 
 def reset(name: str):
@@ -84,5 +86,7 @@ def reset(name: str):
 
 
 def reset_all():
-    for c in _registry.values():
+    with _registry_lock:
+        counters = list(_registry.values())
+    for c in counters:
         c.reset()

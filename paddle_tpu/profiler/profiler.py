@@ -218,6 +218,8 @@ class Profiler:
         if self._profile_memory:
             from .. import device as dev_api
 
+            # don't steal an externally-enabled sampler on disable
+            self._mem_sampling_was_on = dev_api._sampling_installed
             dev_api.enable_peak_sampling()
         if self._device_trace and not self._device_tracing:
             try:
@@ -236,7 +238,8 @@ class Profiler:
 
         dispatch.set_profile_hook(None)
         _active_recorder = None
-        if self._profile_memory:
+        if self._profile_memory and not getattr(
+                self, "_mem_sampling_was_on", False):
             from .. import device as dev_api
 
             dev_api.disable_peak_sampling()

@@ -1,0 +1,109 @@
+"""Trial-launching auto-tuner + memory cost model (round-5 VERDICT item 6;
+reference `python/paddle/distributed/auto_tuner/tuner.py` launches real
+trial jobs, `memory_cost_model.py` prunes infeasible configs)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner.memory_model import (
+    estimate_bytes_per_device, prune_by_memory, transformer_param_count)
+from paddle_tpu.distributed.auto_tuner.tuner import AutoTuner
+
+MODEL = {"vocab_size": 64, "num_layers": 2, "hidden_size": 32,
+         "num_heads": 4}
+
+
+class TestMemoryModel:
+    def test_param_count_matches_actual_model(self):
+        import os
+
+        os.environ.setdefault("XLA_FLAGS", "")
+        from paddle_tpu.jit import state_arrays
+        from paddle_tpu.models import llama_tiny
+
+        m = llama_tiny(vocab=64, layers=2, hidden=32, heads=4, seq=32)
+        actual = sum(int(np.prod(v.shape))
+                     for v in state_arrays(m).values())
+        est = transformer_param_count({
+            "vocab_size": 64, "num_layers": 2, "hidden_size": 32,
+            "intermediate_size": 96})
+        # analytical count within 10% of the real tiny llama
+        assert abs(est - actual) / actual < 0.10, (est, actual)
+
+    def test_estimate_monotonic(self):
+        base = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                    micro_batch_size=2)
+        e1 = estimate_bytes_per_device(base, MODEL, seq_len=32)
+        e_mp = estimate_bytes_per_device({**base, "mp_degree": 8}, MODEL,
+                                         seq_len=32)
+        e_mbs = estimate_bytes_per_device(
+            {**base, "micro_batch_size": 8}, MODEL, seq_len=32)
+        assert e_mp < e1 < e_mbs
+
+    def test_remat_cuts_activations(self):
+        cfg = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                   micro_batch_size=8)
+        full = estimate_bytes_per_device(cfg, MODEL, seq_len=128)
+        re = estimate_bytes_per_device(cfg, MODEL, seq_len=128, remat=True)
+        assert re < full
+
+    def test_prune_by_memory_splits(self):
+        cands = [dict(dp_degree=8, mp_degree=1, pp_degree=1,
+                      micro_batch_size=2),
+                 dict(dp_degree=1, mp_degree=8, pp_degree=1,
+                      micro_batch_size=2)]
+        tuner_cfg = {"model": MODEL, "seq_len": 32,
+                     "memory_limit_bytes": 300_000}
+        keep, pruned = prune_by_memory(cands, tuner_cfg)
+        # mp=8 shards params+activations 8x: it survives; mp=1 does not
+        assert [c["mp_degree"] for c in keep] == [8]
+        assert pruned and "pruned" in pruned[0]["error"]
+        assert pruned[0]["estimated_bytes"] > keep[0]["estimated_bytes"]
+
+
+def test_subprocess_tuner_tunes_tiny_llama():
+    """End-to-end: >=6 candidate (dp,mp,pp,mbs) configs launched as real
+    subprocess jobs on the 8-device CPU mesh; tok/s + peak memory
+    recorded; the measured-best is returned."""
+    tuner_cfg = {
+        "num_devices": 8,
+        "global_batch_size": 16,
+        "dp_degree": "auto", "mp_degree": "auto",
+        "pp_degree": [1, 8],
+        "micro_batch_size": [1, 2],
+        # one consistent layer count: pp=8 needs layers % 8 == 0, and the
+        # trial must run the same depth the prune admitted
+        "model": {**MODEL, "num_layers": 8},
+        "seq_len": 32,
+        "timing_steps": 1,
+        "metric": "tok_per_sec", "maximize": True,
+        "launch_trials": True, "trial_timeout": 180,
+        "memory_limit_bytes": 64 * 1024 * 1024,
+    }
+    tuner = AutoTuner(tuner_cfg)
+    assert len(tuner.candidates) >= 6, [
+        (c["dp_degree"], c["mp_degree"], c["pp_degree"])
+        for c in tuner.candidates]
+    best = tuner.tune(max_trials=7)
+    ok = [h for h in tuner.recorder.history if h.get("error") is None]
+    assert len(ok) >= 3, tuner.recorder.history
+    # every successful trial carries real measurements
+    for h in ok:
+        assert h["tok_per_sec"] > 0
+        assert h["peak_mem_bytes"] > 100_000
+    # best is the measured argmax
+    assert best["tok_per_sec"] == max(h["tok_per_sec"] for h in ok)
+
+
+def test_memory_pruned_configs_recorded_not_launched():
+    tuner_cfg = {
+        "num_devices": 8, "global_batch_size": 16,
+        "dp_degree": [8], "mp_degree": [1], "pp_degree": [1],
+        "micro_batch_size": [2],
+        "model": MODEL, "seq_len": 32,
+        "memory_limit_bytes": 100_000,  # below any config's estimate
+    }
+    tuner = AutoTuner(tuner_cfg)
+    assert tuner.candidates == []
+    assert tuner.pruned
+    recorded = tuner.recorder.history
+    assert recorded and all("pruned" in h["error"] for h in recorded)
