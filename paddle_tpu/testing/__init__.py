@@ -1,0 +1,9 @@
+"""paddle_tpu.testing — the framework's op-level test harness.
+
+TPU-native analog of the reference's OpTest infrastructure
+(`/root/reference/test/legacy_test/op_test.py:418`): a generic runner
+that synthesizes valid inputs per public export, checks forward numerics
+against numpy/scipy references where a direct analog exists, and verifies
+gradients against central finite differences.
+"""
+from .op_harness import run_export, sweep  # noqa: F401
