@@ -1,4 +1,5 @@
-from . import autograd, dispatch
+from . import autograd, dispatch, lazy
 from .autograd import enable_grad, grad, is_grad_enabled, no_grad, run_backward, set_grad_enabled
 from .dispatch import apply, get_op, op_registry, register_op
+from .lazy import LazyArray, is_lazy_enabled, lazy_guard, set_lazy_mode, sync
 from .tensor import Tensor

@@ -270,6 +270,7 @@ def _discover(seed_nodes):
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
     """paddle Tensor.backward() entry (reference: fluid/eager/backward.cc:105)."""
+    from . import lazy
     from .tensor import Tensor
 
     if not isinstance(tensors, (list, tuple)):
@@ -278,6 +279,11 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
+
+    # materialization barrier: the seed output must be concrete and carry
+    # its (region) grad node before cotangents are seeded. When eligible
+    # the flush fuses the region's forward AND backward into one program.
+    lazy.sync_backward(tensors, grad_tensors, retain_graph)
 
     grads_by_node = _seed_cotangents(tensors, grad_tensors)
     if not grads_by_node:
@@ -294,10 +300,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
          allow_unused=False, no_grad_vars=None):
     """paddle.grad — compute grads of outputs w.r.t. inputs without touching .grad."""
+    from . import lazy
     from .tensor import Tensor
 
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # barrier with region CUTS at requested inputs, so intermediates get a
+    # surfaced cotangent (fused away otherwise)
+    lazy.sync_for_grad(outputs, inputs)
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):

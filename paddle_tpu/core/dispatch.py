@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from ..framework import flags
-from . import autograd
+from ..framework.dtype import is_inexact_np
+from . import autograd, lazy
 
 _OP_REGISTRY: Dict[str, "OpDef"] = {}
 
@@ -88,18 +89,20 @@ def op_registry() -> Dict[str, OpDef]:
 
 _fwd_cache: Dict[tuple, Callable] = {}
 _fwd_vjp_cache: Dict[tuple, Callable] = {}
+_fwd_grad_cache: Dict[tuple, Callable] = {}
 
 _compile_count = 0
 
 
 def cache_stats():
     return {"fwd": len(_fwd_cache), "fwd_vjp": len(_fwd_vjp_cache),
-            "compiles": _compile_count}
+            "fwd_grad": len(_fwd_grad_cache), "compiles": _compile_count}
 
 
 def clear_caches():
     _fwd_cache.clear()
     _fwd_vjp_cache.clear()
+    _fwd_grad_cache.clear()
 
 
 def _canon_attr(v):
@@ -195,6 +198,51 @@ def _get_fwd_vjp(op: OpDef, attrs: dict, arrays, mask) -> Callable:
     return fn
 
 
+def _get_fwd_grad(op: OpDef, attrs: dict, arrays, mask, seed_slots,
+                  seed_arrays) -> Callable:
+    """One executable computing BOTH the op's outputs and its gradients
+    w.r.t. masked inputs, with runtime seed cotangents added at
+    `seed_slots` of the (tuple) outputs. The lazy tracer's `backward()`
+    fast path: the whole fused region's fwd+bwd is a single XLA program
+    (no residual materialization between them)."""
+    jax = _jax()
+    key = (op.name, _attr_key(attrs), _aval_key(arrays), mask,
+           tuple(seed_slots), _aval_key(seed_arrays))
+    fn = _fwd_grad_cache.get(key)
+    if fn is None:
+        _evict(_fwd_grad_cache)
+        _log_compile("fwd_grad", op.name, key)
+        base = op.fn
+        if attrs:
+            base = functools.partial(base, **attrs)
+        n_in = len(arrays)
+
+        def fwd_grad(*args, _base=base, _mask=mask, _n=n_in,
+                     _slots=tuple(seed_slots)):
+            xs, seeds = args[:_n], args[_n:]
+            prims = [a if m else jax.lax.stop_gradient(a)
+                     for a, m in zip(xs, _mask)]
+            # vjp over the SEEDED outputs only — unseeded outputs (logits
+            # kept alive by the user, metrics, ...) ride along as aux from
+            # the SAME forward pass and contribute no backward work.
+            def f(*p):
+                o = tuple(_base(*p))
+                return tuple(o[s] for s in _slots), o
+
+            souts, vjp_fn, outs = jax.vjp(f, *prims, has_aux=True)
+            cts = [s.astype(o.dtype) for s, o in zip(seeds, souts)]
+            grads = vjp_fn(tuple(cts))
+            # only mask-True slots carry real gradients; dropping the rest
+            # avoids materializing zero / float0 outputs (float0 also knocks
+            # the call off the pjit fast path)
+            grads = tuple(g for g, m in zip(grads, _mask) if m)
+            return outs, grads
+
+        fn = jax.jit(fwd_grad)
+        _fwd_grad_cache[key] = fn
+    return fn
+
+
 @functools.lru_cache(maxsize=1)
 def _vjp_caller():
     jax = _jax()
@@ -216,8 +264,6 @@ def _vjp_caller():
 
 
 def _differentiable(a) -> bool:
-    from ..framework.dtype import is_inexact_np
-
     return a is not None and is_inexact_np(a.dtype)
 
 
@@ -267,6 +313,14 @@ def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     if _amp_hook is not None:
         tensor_inputs = _amp_hook(op_name, tensor_inputs)
 
+    # Lazy eager mode: record into the pending micro-graph instead of
+    # executing (core/lazy.py); falls through to the immediate path when
+    # recording declines (tracer inputs, aval-inference failure).
+    if lazy.is_lazy_enabled():
+        out = lazy.try_record(op, tensor_inputs, attrs)
+        if out is not lazy._NOT_HANDLED:
+            return out
+
     # One scan over the inputs: unwrap arrays, detect tracers, build the
     # per-slot differentiability mask (the reference folds this into the
     # generated ad_func prologue, `eager_gen.py:1887`).
@@ -278,6 +332,10 @@ def _apply(op_name: str, tensor_inputs: Sequence, attrs: Optional[dict] = None):
     for t in tensor_inputs:
         if isinstance(t, Tensor):
             a = t._data
+            if type(a) is lazy.LazyArray:
+                # pending value consumed by a non-lazy dispatch: barrier
+                a = a._concrete if a._concrete is not None \
+                    else a.materialize()
             arrays.append(a)
             if isinstance(a, Tracer):
                 has_tracer = True

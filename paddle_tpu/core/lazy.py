@@ -1,0 +1,886 @@
+"""Lazy op-batching eager tracer: fuse eager micro-graphs into ONE compiled
+executable per flush.
+
+The per-op dispatch path (core/dispatch.py) compiles and launches one XLA
+executable per eager op — correct, but on TPU the launch/dispatch overhead
+dominates small ops (bench.py `eager_vs_compiled_ratio`). This module closes
+the gap LazyTensor-style: with lazy mode enabled, `dispatch.apply` RECORDS
+each op into a pending micro-graph (nodes = registered ops + attrs, edges =
+tensor data deps) and returns Tensors backed by `LazyArray` handles that
+carry only avals (shape/dtype via `jax.eval_shape`), so shape/dtype/ndim
+queries never force execution.
+
+The pending graph is flushed as ONE jit-compiled executable when a
+materialization barrier is hit:
+
+- a value is observed: `.numpy()` / `.item()` / `print` / `__bool__` /
+  control flow on values / any `np.asarray`/`jnp.asarray` conversion
+  (`LazyArray.__array__` / `__jax_array__`);
+- `backward()` / `paddle.grad` run (the seed cotangent needs the concrete
+  output and the region's grad node);
+- a non-lazy API consumes the buffer (anything reaching jax directly goes
+  through `__jax_array__`, which materializes);
+- an explicit `paddle_tpu.core.sync()`;
+- the graph reaches `FLAGS_lazy_max_ops` recorded ops (size threshold);
+- a grad-requiring op consumes a stop-gradient lazy intermediate (the
+  no_grad -> grad boundary, e.g. optimizer update feeding the next forward:
+  flushing here keeps the param a LEAF of the new autograd region exactly
+  like immediate mode).
+
+Each flushed region is registered as a real multi-output op
+(``__lazy_region_<n>`` keyed by graph STRUCTURE: op sequence, attrs, wiring,
+grad masks, live-output set) and executed through the same
+`dispatch._get_fwd` / `_get_fwd_vjp` executable cache, keyed additionally by
+leaf avals — so a steady-state training step replays one cached executable
+with zero retracing. Autograd composes: the whole region becomes ONE
+`autograd.OpGradNode` whose vjp is the region's compiled vjp (backward for a
+hundred fused ops is a single executable), and double backward re-executes
+the region op through `dispatch.apply_vjp` like any other op.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import weakref
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..framework import flags, monitor
+from ..framework.dtype import is_inexact_np
+from . import autograd
+
+__all__ = ["LazyArray", "is_lazy_enabled", "set_lazy_mode", "lazy_guard",
+           "sync", "pending_ops"]
+
+flags.define_flag("lazy_mode", False,
+                  "batch eager ops into fused lazily-compiled regions")
+flags.define_flag("lazy_max_ops", 4096,
+                  "flush the pending lazy micro-graph at this many ops")
+
+_NOT_HANDLED = object()
+
+_state = threading.local()
+
+# graph-structure signature -> registered region op name (process-wide; the
+# compiled executables themselves live in dispatch's bounded caches).
+# Bounded FIFO: pathological workloads with data-dependent op sequences would
+# otherwise grow the registry forever; evicted regions re-register under a
+# new name if re-encountered, and live grad nodes re-register on demand for
+# double backward (_RegionNode.run_differentiable).
+_region_sigs: Dict[tuple, str] = {}
+_region_counter = itertools.count()
+_REGION_LIMIT = 1024
+
+# (op, attr_key, input avals) -> (((shape, dtype), ...), is_tuple)
+_aval_cache: Dict[tuple, tuple] = {}
+_AVAL_CACHE_LIMIT = 8192
+
+
+def is_lazy_enabled() -> bool:
+    v = getattr(_state, "enabled", None)
+    if v is None:
+        v = bool(flags.flag_value("lazy_mode"))
+        _state.enabled = v
+    return v
+
+
+def set_lazy_mode(enable: bool) -> bool:
+    """Switch lazy eager mode for this thread; returns the previous value.
+    Disabling flushes any pending ops (no recorded work is lost)."""
+    prev = is_lazy_enabled()
+    _state.enabled = bool(enable)
+    if prev and not enable:
+        sync(reason="disable")
+    return prev
+
+
+class lazy_guard:
+    """Context manager scoping lazy mode: ``with lazy_guard(): ...``."""
+
+    def __init__(self, enable: bool = True):
+        self._enable = enable
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_lazy_mode(self._enable)
+        return self
+
+    def __exit__(self, *exc):
+        set_lazy_mode(self._prev)
+        return False
+
+
+def _graph() -> "LazyGraph":
+    g = getattr(_state, "graph", None)
+    if g is None:
+        g = _state.graph = LazyGraph()
+    return g
+
+
+def sync(reason: str = "sync"):
+    """Flush any pending lazy ops (materialization barrier).
+
+    Exposed as ``paddle_tpu.core.sync()``. No-op when nothing is pending."""
+    g = getattr(_state, "graph", None)
+    if g is not None and g.nodes:
+        g.flush(reason)
+
+
+def pending_ops() -> int:
+    """Number of ops currently recorded and not yet flushed (test hook)."""
+    g = getattr(_state, "graph", None)
+    return 0 if g is None else len(g.nodes)
+
+
+def sync_backward(tensors, grad_tensors, retain_graph):
+    """Materialization barrier for `backward()`. When every pending seed
+    output belongs to the current graph and the graph won't be re-run
+    (retain_graph off), the flush compiles forward AND backward as one
+    executable; otherwise it falls back to the plain region flush."""
+    g = getattr(_state, "graph", None)
+    if g is None or not g.nodes:
+        return
+    seeds = []
+    ok = not retain_graph
+    if ok:
+        for t, gt in zip(tensors, grad_tensors):
+            a = getattr(t, "_data", None)
+            if type(a) is LazyArray and a._concrete is None:
+                if a._graph is not g:
+                    ok = False
+                    break
+                seeds.append((a, gt))
+    if ok and seeds:
+        g.flush("backward", _seeds=seeds)
+    else:
+        g.flush("backward")
+
+
+def sync_for_grad(outputs, inputs):
+    """Barrier for `paddle.grad`: any requested input that is a pending
+    INTERMEDIATE becomes a region boundary (partial flushes), so its
+    cotangent surfaces between regions instead of being fused away."""
+    while True:
+        g = getattr(_state, "graph", None)
+        if g is None or not g.nodes:
+            return
+        cuts = [t._data._node for t in inputs
+                if t is not None and type(getattr(t, "_data", None))
+                is LazyArray and t._data._concrete is None
+                and t._data._graph is g]
+        if not cuts:
+            g.flush("backward")
+            return
+        g.flush_upto(min(cuts) + 1, "grad_cut")
+
+
+# ---------------------------------------------------------------------------
+# LazyArray: the deferred buffer handle
+# ---------------------------------------------------------------------------
+
+
+class LazyArray:
+    """A not-yet-computed array: aval now, value at flush.
+
+    Stands in for a `jax.Array` inside `Tensor._data`. Metadata (shape /
+    dtype / ndim / size) comes from the recorded aval without executing
+    anything; any VALUE observation (`__array__`, `__jax_array__`, item,
+    bool, indexing, unknown attribute) materializes by flushing the owning
+    graph. After the flush the concrete array is swapped into every owning
+    Tensor, and this handle keeps delegating for stragglers holding a raw
+    reference."""
+
+    __slots__ = ("_graph", "_node", "_out", "_shape", "_dtype", "_concrete",
+                 "_owners", "__weakref__")
+
+    def __init__(self, graph, node_idx, out_idx, shape, dtype):
+        self._graph = graph
+        self._node = node_idx
+        self._out = out_idx
+        self._shape = tuple(shape)
+        self._dtype = np.dtype(dtype)
+        self._concrete = None
+        self._owners = weakref.WeakSet()
+
+    # -- aval metadata: never forces a flush --------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    @property
+    def nbytes(self):
+        return self.size * self._dtype.itemsize
+
+    # -- materialization barriers -------------------------------------------
+    def materialize(self):
+        if self._concrete is None:
+            self._graph.flush("value")
+            if self._concrete is None:
+                raise RuntimeError(
+                    "lazy value was lost: its graph flushed without "
+                    "producing this output (flush error?)")
+        return self._concrete
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self.materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+    def __len__(self):
+        if not self._shape:
+            raise TypeError("len() of a 0-d lazy array")
+        return self._shape[0]
+
+    def __bool__(self):
+        return bool(np.asarray(self.materialize()))
+
+    def __int__(self):
+        return int(np.asarray(self.materialize()))
+
+    def __float__(self):
+        return float(np.asarray(self.materialize()))
+
+    def __index__(self):
+        return int(np.asarray(self.materialize()))
+
+    def block_until_ready(self):
+        m = self.materialize()
+        return m.block_until_ready() if hasattr(m, "block_until_ready") else m
+
+    def __repr__(self):
+        state = "materialized" if self._concrete is not None else "pending"
+        return (f"LazyArray(shape={self._shape}, dtype={self._dtype}, "
+                f"{state})")
+
+    def __getattr__(self, name):
+        # anything beyond aval metadata (.at, .devices, .sharding, .astype,
+        # .sum, ...) is a value observation: materialize and delegate
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self.materialize(), name)
+
+    # arithmetic on the raw handle (e.g. cotangent accumulation) behaves
+    # like the concrete array
+    def _delegate_binop(name):  # noqa: N805
+        def op(self, other):
+            return getattr(self.materialize(), name)(other)
+
+        op.__name__ = name
+        return op
+
+    __add__ = _delegate_binop("__add__")
+    __radd__ = _delegate_binop("__radd__")
+    __sub__ = _delegate_binop("__sub__")
+    __rsub__ = _delegate_binop("__rsub__")
+    __mul__ = _delegate_binop("__mul__")
+    __rmul__ = _delegate_binop("__rmul__")
+    __truediv__ = _delegate_binop("__truediv__")
+    __rtruediv__ = _delegate_binop("__rtruediv__")
+    __matmul__ = _delegate_binop("__matmul__")
+    __rmatmul__ = _delegate_binop("__rmatmul__")
+    __pow__ = _delegate_binop("__pow__")
+    __neg__ = lambda self: -self.materialize()  # noqa: E731
+    del _delegate_binop
+
+
+# ---------------------------------------------------------------------------
+# The pending micro-graph
+# ---------------------------------------------------------------------------
+
+
+class _Node:
+    __slots__ = ("op_name", "fn", "attrs", "attr_key", "in_refs",
+                 "slot_masks", "requires", "multi", "out_avals", "out_sg",
+                 "out_refs", "owner_refs", "_sig")
+
+    def __init__(self, op_name, fn, attrs, attr_key, in_refs, slot_masks,
+                 requires, multi, out_avals, out_sg):
+        self.op_name = op_name
+        self.fn = fn
+        self.attrs = attrs
+        self.attr_key = attr_key
+        # in_refs[i]: ("l", leaf_idx) | ("n", node_idx, out_idx) | ("c",)
+        self.in_refs = in_refs
+        self.slot_masks = slot_masks
+        self.requires = requires
+        self.multi = multi
+        self.out_avals = out_avals          # ((shape, np.dtype), ...)
+        self.out_sg = out_sg                # stop_gradient per output
+        self.out_refs: List = []            # weakrefs to LazyArrays
+        self.owner_refs: List = []          # weakrefs to primary Tensors
+
+
+class _Leaf:
+    __slots__ = ("array", "mask", "edge", "sg", "tensor")
+
+    def __init__(self, array, mask, edge, sg, tensor=None):
+        self.array = array    # concrete value, frozen at record time
+        self.mask = mask      # participates in region grad
+        self.edge = edge      # (grad_node, out_index) | None
+        self.sg = sg
+        # strong ref for grad leaves: their dedup key is id(tensor), which
+        # is only stable while the tensor is alive — the graph owns it
+        self.tensor = tensor
+
+
+class LazyGraph:
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.leaves: List[_Leaf] = []
+        self._leaf_by_id: Dict[int, int] = {}
+        self.requires_any = False
+        self._flushed = False
+        self._region_node = None
+        self._live_index: Dict[Tuple[int, int], int] = {}
+
+    def _add_leaf(self, array, mask, tensor) -> int:
+        # dedup key: the TENSOR for grad-requiring inputs (two Tensors
+        # sharing one buffer each need their own leaf so the region vjp
+        # attributes gradients per tape edge), the buffer otherwise
+        key = id(tensor) if (mask and tensor is not None) else id(array)
+        idx = self._leaf_by_id.get(key)
+        if idx is not None:
+            return idx
+        edge = _edge_of(tensor) if mask else None
+        sg = True if tensor is None else tensor.stop_gradient
+        idx = len(self.leaves)
+        self.leaves.append(_Leaf(array, mask, edge, sg,
+                                 tensor if mask else None))
+        self._leaf_by_id[key] = idx
+        return idx
+
+    # -- flush --------------------------------------------------------------
+    def flush(self, reason: str, _seeds=None):
+        """Execute the whole pending graph as one compiled region.
+
+        `_seeds` (from `sync_backward`): list of (LazyArray, grad_tensor)
+        seed pairs — when eligible the region compiles as ONE fwd+grad
+        executable (`dispatch._get_fwd_grad`) so the entire train step's
+        forward AND backward are a single XLA program."""
+        if self._flushed or not self.nodes:
+            return
+        self._flushed = True
+        self._region_node = None
+        self._live_index = {}
+        if getattr(_state, "graph", None) is self:
+            _state.graph = LazyGraph()  # records during flush start fresh
+
+        from . import dispatch
+
+        t0 = None
+        if dispatch._profile_cb is not None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+
+        live = []
+        live_index = {}
+        for i, node in enumerate(self.nodes):
+            for j, ref in enumerate(node.out_refs):
+                laz = ref()
+                if laz is not None and laz._concrete is None:
+                    live_index[(i, j)] = len(live)
+                    live.append((i, j))
+        self._live_index = live_index
+
+        n_ops = len(self.nodes)
+        monitor.inc("lazy.flushes")
+        monitor.inc(f"lazy.flushes.{reason}")
+        monitor.inc("lazy.fused_ops", n_ops)
+        monitor.set_max("lazy.max_region_ops", n_ops)
+
+        if not live:
+            # nothing the program can ever observe: drop the region
+            monitor.inc("lazy.flushes_dead")
+            return
+
+        outs = node = None
+        if _seeds is not None and self._fusable(_seeds):
+            try:
+                outs, node = self._run_fused(live, live_index, _seeds)
+            except Exception:
+                monitor.inc("lazy.flush_fallbacks")
+                outs = None
+        if outs is None:
+            try:
+                outs, node = self._run(live, jit=True)
+            except Exception:
+                monitor.inc("lazy.flush_fallbacks")
+                outs, node = self._run(live, jit=False)
+
+        out_tensors = self._distribute(live, outs, node)
+        self._region_node = node
+
+        if t0 is not None and dispatch._profile_cb is not None:
+            import time as _time
+
+            dispatch._profile_cb(f"lazy_region_flush[{reason}]", t0,
+                                 _time.perf_counter())
+        dispatch._maybe_check_nan_inf(self._region_name(live), out_tensors)
+
+    def flush_upto(self, k: int, reason: str):
+        """Partial flush: execute nodes[:k], rebuild the remainder as a new
+        pending graph whose references to flushed outputs become concrete
+        leaves (with tape edges into the flushed region). Lets
+        `paddle.grad(y, x)` cut the region at an intermediate `x` so x's
+        cotangent surfaces at a region boundary."""
+        if self._flushed or not self.nodes:
+            return
+        if k >= len(self.nodes):
+            return self.flush(reason)
+        tail = self.nodes[k:]
+        self.nodes = self.nodes[:k]
+
+        # keep head outputs consumed by the tail alive through the flush
+        keep = []
+        ref_map = {}
+        for nd in tail:
+            for ref in nd.in_refs:
+                if ref[0] == "n" and ref[1] < k and (ref[1], ref[2]) \
+                        not in ref_map:
+                    i, j = ref[1], ref[2]
+                    laz = self.nodes[i].out_refs[j]()
+                    if laz is None:
+                        shape, dt = self.nodes[i].out_avals[j]
+                        laz = LazyArray(self, i, j, shape, dt)
+                        self.nodes[i].out_refs[j] = weakref.ref(laz)
+                    ref_map[(i, j)] = laz
+                    keep.append(laz)
+
+        self.flush(reason)
+
+        interim = getattr(_state, "graph", None)
+        new = LazyGraph()
+        leaf_map: Dict[int, int] = {}
+
+        def remap(ref):
+            if ref[0] == "l":
+                old = ref[1]
+                ni = leaf_map.get(old)
+                if ni is None:
+                    lf = self.leaves[old]
+                    ni = leaf_map[old] = len(new.leaves)
+                    new.leaves.append(lf)
+                    new._leaf_by_id[id(lf.array)] = ni
+                return ("l", ni)
+            if ref[0] == "n":
+                if ref[1] >= k:
+                    return ("n", ref[1] - k, ref[2])
+                i, j = ref[1], ref[2]
+                laz = ref_map[(i, j)]
+                val = laz._concrete
+                sg = self.nodes[i].out_sg[j]
+                edge = None
+                if self._region_node is not None and not sg:
+                    edge = (self._region_node, self._live_index[(i, j)])
+                ni = new._leaf_by_id.get(id(val))
+                if ni is None:
+                    ni = len(new.leaves)
+                    new.leaves.append(_Leaf(val, edge is not None, edge, sg))
+                    new._leaf_by_id[id(val)] = ni
+                return ("l", ni)
+            return ref
+
+        for nd in tail:
+            nd.in_refs = tuple(remap(r) for r in nd.in_refs)
+            nd._sig = (nd.op_name, nd.attr_key, nd.in_refs, nd.slot_masks,
+                       nd.requires, nd.multi, len(nd.out_avals))
+            new.nodes.append(nd)
+            new.requires_any = new.requires_any or nd.requires
+            for ref in nd.out_refs:
+                laz = ref()
+                if laz is not None:
+                    laz._graph = new
+                    laz._node -= k
+        if interim is not None and interim.nodes:
+            interim.flush(reason)  # observer-recorded ops during the flush
+        _state.graph = new
+
+    def _fusable(self, seeds) -> bool:
+        if not (self.requires_any and any(lf.mask for lf in self.leaves)):
+            return False
+        for laz, gt in seeds:
+            nd = self.nodes[laz._node]
+            if nd.out_sg[laz._out] or not _inexact(nd.out_avals[laz._out][1]):
+                return False
+        return True
+
+    def _signature(self, live) -> tuple:
+        # per-node sig pieces are prebuilt at record time (hot path)
+        return (tuple(nd._sig for nd in self.nodes), tuple(live),
+                len(self.leaves))
+
+    def _region_name(self, live) -> str:
+        from . import dispatch
+
+        sig = self._signature(live)
+        name = _region_sigs.get(sig)
+        if name is None:
+            while len(_region_sigs) >= _REGION_LIMIT:
+                old_name = _region_sigs.pop(next(iter(_region_sigs)))
+                dispatch.op_registry().pop(old_name, None)
+                dispatch.op_registry().pop(f"__vjp__{old_name}", None)
+            name = f"__lazy_region_{next(_region_counter)}"
+            _region_sigs[sig] = name
+            specs = [(nd.fn, nd.attrs, nd.in_refs, nd.slot_masks,
+                      nd.requires, nd.multi) for nd in self.nodes]
+            dispatch.register_op(name, _build_region_fn(specs, tuple(live)),
+                                 multi_out=True)
+        return name
+
+    def _run(self, live, jit: bool):
+        from . import dispatch
+
+        name = self._region_name(live)
+        op = dispatch.get_op(name)
+        arrays = [lf.array for lf in self.leaves]
+        requires = self.requires_any and any(lf.mask for lf in self.leaves)
+
+        if not requires:
+            if jit:
+                outs = dispatch._get_fwd(op, {}, arrays)(*arrays)
+            else:
+                outs = op.fn(*arrays)
+            return list(outs), None
+
+        mask = tuple(lf.mask for lf in self.leaves)
+        if jit:
+            outs, vjp_fn = dispatch._get_fwd_vjp(op, {}, arrays,
+                                                 mask)(*arrays)
+        else:
+            import jax
+
+            prims = [a if m else jax.lax.stop_gradient(a)
+                     for a, m in zip(arrays, mask)]
+            outs, vjp_fn = jax.vjp(lambda *xs: op.fn(*xs), *prims)
+        node = self._make_node(name, len(live), vjp_fn, mask)
+        return list(outs), node
+
+    def _run_fused(self, live, live_index, seeds):
+        """ONE compiled program for the region's forward AND its gradient
+        w.r.t. the masked leaves (the `backward()` barrier fast path)."""
+        import jax.numpy as jnp
+
+        from . import dispatch
+
+        name = self._region_name(live)
+        op = dispatch.get_op(name)
+        arrays = [lf.array for lf in self.leaves]
+        mask = tuple(lf.mask for lf in self.leaves)
+
+        seed_slots = []
+        seed_arrays = []
+        for laz, gt in seeds:
+            seed_slots.append(live_index[(laz._node, laz._out)])
+            if gt is None:
+                seed_arrays.append(jnp.ones(laz.shape, laz.dtype))
+            else:
+                d = gt._data if hasattr(gt, "_data") else jnp.asarray(gt)
+                if type(d) is LazyArray:
+                    d = d.materialize()
+                seed_arrays.append(d)
+
+        fn = dispatch._get_fwd_grad(op, {}, arrays, mask,
+                                    tuple(seed_slots), seed_arrays)
+        outs, grads = fn(*arrays, *seed_arrays)
+        node = self._make_node(name, len(live), None, mask,
+                               grads=list(grads))
+        monitor.inc("lazy.fused_backward")
+        return list(outs), node
+
+    def _make_node(self, name, n_live, vjp_fn, mask, grads=None):
+        from . import dispatch
+
+        region_fn = dispatch.get_op(name).fn
+        if grads is None:
+            node = _RegionNode(name, n_live, vjp_fn, mask,
+                               dispatch._vjp_caller(), region_fn)
+        else:
+            node = _FusedBackwardNode(name, n_live, mask, grads,
+                                      dispatch._vjp_caller(), region_fn)
+        node.attrs = {}
+        node.primals = [
+            ("__tensor__", lf.array,
+             lf.edge[0] if lf.edge else None,
+             lf.edge[1] if lf.edge else 0, lf.sg)
+            for lf in self.leaves]
+        node.edges = [lf.edge for lf in self.leaves]
+        return node
+
+    def _distribute(self, live, outs, node):
+        """Swap concrete buffers into every owning Tensor and attach the
+        region grad node to tape-carrying outputs."""
+        out_tensors = []
+        for k, (i, j) in enumerate(live):
+            nd = self.nodes[i]
+            concrete = outs[k]
+            laz = nd.out_refs[j]()
+            attach = node is not None and not nd.out_sg[j]
+            if laz is not None:
+                laz._concrete = concrete
+                for t in list(laz._owners):
+                    if t._data is laz:
+                        t._data = concrete
+                        if attach and not t._stop_gradient and \
+                                t._grad_node is None:
+                            t._grad_node = node
+                            t._out_index = k
+            owner = nd.owner_refs[j]()
+            if node is not None:
+                node.out_avals.append((nd.out_avals[j][0],
+                                       nd.out_avals[j][1]))
+                node.out_hooks.append(owner._hooks if owner is not None
+                                      else [])
+            if owner is not None:
+                out_tensors.append(owner)
+        return out_tensors
+
+
+def _build_region_fn(specs, live):
+    """Pure-jax replay of the recorded micro-graph; one registered op."""
+
+    def region(*leaf_arrays):
+        import jax
+
+        vals: List[list] = []
+        for fn, attrs, in_refs, slot_masks, requires, multi in specs:
+            args = []
+            for ref, m in zip(in_refs, slot_masks):
+                if ref[0] == "l":
+                    v = leaf_arrays[ref[1]]
+                elif ref[0] == "n":
+                    v = vals[ref[1]][ref[2]]
+                else:
+                    v = None
+                if requires and not m and v is not None:
+                    # replicate the per-op stop_gradient the immediate path
+                    # applies to non-differentiable input slots
+                    v = jax.lax.stop_gradient(v)
+                args.append(v)
+            out = fn(*args, **attrs) if attrs else fn(*args)
+            outs = list(out) if multi else [out]
+            if not requires:
+                # ops recorded under no_grad never carry gradient
+                outs = [jax.lax.stop_gradient(o) for o in outs]
+            vals.append(outs)
+        return tuple(vals[i][j] for i, j in live)
+
+    return region
+
+
+class _RegionNode(autograd.OpGradNode):
+    """Grad node of a flushed region. Holds the region replay fn so double
+    backward keeps working even after the (bounded) region registry evicted
+    this region's op."""
+
+    __slots__ = ("region_fn",)
+
+    def __init__(self, name, n_outputs, vjp_fn, in_mask, vjp_caller,
+                 region_fn):
+        super().__init__(name, n_outputs, vjp_fn, in_mask, True, vjp_caller)
+        self.region_fn = region_fn
+
+    def run_differentiable(self, ct_tensors):
+        from . import dispatch
+
+        if self.name not in dispatch.op_registry():
+            dispatch.register_op(self.name, self.region_fn, multi_out=True)
+        return super().run_differentiable(ct_tensors)
+
+
+class _FusedBackwardNode(_RegionNode):
+    """Region grad node whose leaf gradients were precomputed inside the
+    fused fwd+grad executable. One-shot: `run` hands the gradients to the
+    traversal exactly once (fusion only engages when retain_graph is off).
+    Double backward still works through the inherited `run_differentiable`
+    (re-executes the registered region op from the primal snapshots)."""
+
+    __slots__ = ("_grads",)
+
+    def __init__(self, name, n_outputs, in_mask, grads, vjp_caller,
+                 region_fn):
+        super().__init__(name, n_outputs, None, in_mask, vjp_caller,
+                         region_fn)
+        self._grads = grads
+
+    def run(self, cotangents):
+        if self._grads is None:
+            raise RuntimeError(
+                f"Trying to backward through node {self.name} a second time "
+                "after its buffers were freed; call "
+                "backward(retain_graph=True) the first time.")
+        grads, self._grads = self._grads, None
+        # grads holds mask-True slots only (the executable drops the rest)
+        it = iter(grads)
+        return [next(it) if m else None for m in self.in_mask]
+
+    def release(self):
+        self._grads = None
+        super().release()
+
+
+def _edge_of(t):
+    if t is None:
+        return None
+    if t._grad_node is not None:
+        return (t._grad_node, t._out_index)
+    return (t._ensure_accum_node(), 0)
+
+
+# ---------------------------------------------------------------------------
+# Recording (called from dispatch._apply when lazy mode is on)
+# ---------------------------------------------------------------------------
+
+
+def try_record(op, tensor_inputs, attrs):
+    """Record one op into the pending graph; returns the lazy output
+    Tensor(s), or _NOT_HANDLED when this op must take the immediate path
+    (tracer inputs, un-keyable inputs, aval inference failure)."""
+    from . import autograd, dispatch
+    from .tensor import Tensor
+
+    Tracer = dispatch._tracer_cls()
+    graph = _graph()
+
+    # pass 1: classify inputs (no graph mutation yet)
+    infos = []  # (tensor|None, value, mask)
+    boundary = False
+    any_mask = False
+    for t in tensor_inputs:
+        if isinstance(t, Tensor):
+            a = t._data
+            lazy_ref = None
+            if type(a) is LazyArray:
+                if a._concrete is not None:
+                    a = a._concrete
+                elif a._graph is not graph or a._graph._flushed:
+                    a = a.materialize()
+                else:
+                    lazy_ref = a
+            if isinstance(a, Tracer):
+                return _NOT_HANDLED
+            live = not t.stop_gradient
+            m = live and dispatch._differentiable(a)
+            if m:
+                any_mask = True
+            infos.append((t, lazy_ref if lazy_ref is not None else a, m))
+        else:
+            if isinstance(t, Tracer):
+                return _NOT_HANDLED
+            if t is not None and not (hasattr(t, "shape")
+                                      and hasattr(t, "dtype")):
+                return _NOT_HANDLED
+            infos.append((None, t, False))
+
+    requires = any_mask and autograd.is_grad_enabled()
+
+    if requires:
+        for t, v, m in infos:
+            if m and type(v) is LazyArray and \
+                    graph.nodes[v._node].out_sg[v._out]:
+                # a grad-REQUIRING slot (mask True) consuming an untracked
+                # lazy product: in-region it could never receive gradients,
+                # so flush first and let it become a concrete LEAF of the
+                # next region (the optimizer-update -> next-forward
+                # boundary). Mask-False consumers (labels, masks, metrics)
+                # keep fusing.
+                boundary = True
+                break
+        if boundary:
+            graph.flush("boundary")
+            return try_record(op, tensor_inputs, attrs)
+
+    # aval inference (cached per op/attrs/input-aval signature)
+    akey = dispatch._attr_key(attrs)
+    # dtype objects hash/compare fine as-is (hot path: no np.dtype() wrap)
+    in_avals = tuple(
+        None if v is None else (tuple(v.shape), v.dtype)
+        for _, v, _ in infos)
+    ckey = (op.name, akey, in_avals)
+    entry = _aval_cache.get(ckey)
+    if entry is None:
+        try:
+            entry = _infer_avals(op, attrs, in_avals)
+        except Exception:
+            monitor.inc("lazy.record_fallbacks")
+            return _NOT_HANDLED
+        if len(_aval_cache) >= _AVAL_CACHE_LIMIT:
+            _aval_cache.pop(next(iter(_aval_cache)))
+        _aval_cache[ckey] = entry
+    out_avals, is_tuple = entry
+
+    # pass 2: mutate the graph
+    in_refs = []
+    slot_masks = []
+    for t, v, m in infos:
+        if v is None:
+            in_refs.append(("c",))
+        elif type(v) is LazyArray:
+            in_refs.append(("n", v._node, v._out))
+        else:
+            in_refs.append(("l", graph._add_leaf(v, m, t)))
+        slot_masks.append(m)
+
+    if requires:
+        out_sg = tuple(not _inexact(dt) for _, dt in out_avals)
+    else:
+        out_sg = (True,) * len(out_avals)
+
+    node_idx = len(graph.nodes)
+    node = _Node(op.name, op.fn, dict(attrs), akey, tuple(in_refs),
+                 tuple(slot_masks), requires, is_tuple, out_avals, out_sg)
+    node._sig = (op.name, akey, node.in_refs, node.slot_masks, requires,
+                 is_tuple, len(out_avals))
+    graph.nodes.append(node)
+    graph.requires_any = graph.requires_any or requires
+
+    results = []
+    for i, (shape, dt) in enumerate(out_avals):
+        laz = LazyArray(graph, node_idx, i, shape, dt)
+        t = Tensor(laz, stop_gradient=out_sg[i])
+        node.out_refs.append(weakref.ref(laz))
+        node.owner_refs.append(weakref.ref(t))
+        results.append(t)
+
+    if len(graph.nodes) >= flags.flag_value("lazy_max_ops"):
+        graph.flush("threshold")
+
+    if not is_tuple:
+        return results[0]
+    return results
+
+
+def _inexact(dt) -> bool:
+    return is_inexact_np(np.dtype(dt))
+
+
+def _infer_avals(op, attrs, in_avals):
+    import jax
+
+    fn = functools.partial(op.fn, **attrs) if attrs else op.fn
+    args = [None if a is None else jax.ShapeDtypeStruct(a[0], a[1])
+            for a in in_avals]
+    out = jax.eval_shape(fn, *args)
+    is_tuple = isinstance(out, (tuple, list))
+    outs = tuple(out) if is_tuple else (out,)
+    return (tuple((tuple(o.shape), np.dtype(o.dtype)) for o in outs),
+            is_tuple)

@@ -18,6 +18,7 @@ import numpy as np
 from ..framework import dtype as dtype_mod
 from ..framework.place import Place
 from . import autograd
+from .lazy import LazyArray
 
 _name_counter = itertools.count()
 
@@ -35,6 +36,8 @@ class Tensor:
         elif isinstance(data, (np.ndarray, int, float, bool, list, tuple)):
             data = jnp.asarray(data)
         self._data = data
+        if type(data) is LazyArray:
+            data._owners.add(self)  # flush swaps in the concrete buffer
         self._stop_gradient = stop_gradient
         self._grad = None
         self._grad_node = None
@@ -115,6 +118,12 @@ class Tensor:
     clear_grad = clear_gradient
 
     def register_hook(self, hook):
+        if type(self._data) is LazyArray and self._data._concrete is None:
+            # a hooked intermediate must be a region OUTPUT with a real tape
+            # edge (inside a fused region its cotangent never surfaces)
+            from . import lazy
+
+            lazy.sync(reason="hook")
         if self._stop_gradient and self._grad_node is None:
             raise RuntimeError("cannot register hook on a tensor that stops gradient")
         self._hooks.append(hook)
@@ -234,6 +243,8 @@ class Tensor:
 
     def _copy_data_from(self, other: "Tensor"):
         self._data = other._data
+        if type(self._data) is LazyArray:
+            self._data._owners.add(self)
 
     def __repr__(self):
         grad_info = "" if self._stop_gradient else ", stop_gradient=False"

@@ -70,6 +70,11 @@ def _live_bytes(dev) -> int:
 
 # per-device high-water marks for the fallback path, keyed by (platform, id)
 _peaks: Dict[tuple, int] = {}
+# backend peak_bytes_in_use snapshot at the last reset: PJRT peaks cannot be
+# reset, so `max_memory_allocated` reports relative to this baseline
+_peak_baseline: Dict[tuple, int] = {}
+# reserved (arena) high-water marks sampled at every reserved/stats query
+_reserved_peaks: Dict[tuple, int] = {}
 _sampling_installed = False
 
 
@@ -98,17 +103,30 @@ def memory_allocated(device=None) -> int:
 
 
 def max_memory_allocated(device=None) -> int:
-    """Peak allocated bytes (reference
-    `paddle.device.cuda.max_memory_allocated`). On backends without
-    allocator stats this is the high-water mark of sampled queries —
+    """Peak allocated bytes since the last `reset_max_memory_allocated`
+    (reference `paddle.device.cuda.max_memory_allocated`). On backends
+    without allocator stats this is the high-water mark of sampled queries —
     sample-at-query plus per-dispatch sampling under
-    `enable_peak_sampling()`."""
+    `enable_peak_sampling()`. On stat-reporting backends the PJRT peak
+    cannot be reset, so after a reset the report is
+    ``max(backend peak if it exceeded the reset baseline, current use,
+    sampled high-water)``."""
     dev = _resolve(device)
     st = _backend_stats(dev)
+    k = _key(dev)
     if st and "peak_bytes_in_use" in st:
-        return int(st["peak_bytes_in_use"])
+        peak = int(st["peak_bytes_in_use"])
+        cur = int(st.get("bytes_in_use", 0))
+        if cur > _peaks.get(k, 0):
+            _peaks[k] = cur
+        base = _peak_baseline.get(k)
+        if base is None:
+            return peak
+        if peak > base:  # a new all-time peak happened after the reset
+            return peak
+        return max(_peaks.get(k, cur), cur)
     memory_allocated(dev)  # refresh the mark
-    return _peaks.get(_key(dev), 0)
+    return _peaks.get(k, 0)
 
 
 def memory_reserved(device=None) -> int:
@@ -116,26 +134,46 @@ def memory_reserved(device=None) -> int:
     backend reports it; otherwise equals allocated)."""
     dev = _resolve(device)
     st = _backend_stats(dev)
+    res = None
     if st:
         for k in ("bytes_reserved", "pool_bytes", "bytes_limit"):
             if k in st:
-                return int(st[k])
-    return memory_allocated(dev)
+                res = int(st[k])
+                break
+    if res is None:
+        res = memory_allocated(dev)
+    k = _key(dev)
+    if res > _reserved_peaks.get(k, 0):
+        _reserved_peaks[k] = res
+    return res
 
 
 def max_memory_reserved(device=None) -> int:
-    return memory_reserved(device)
+    """High-water mark of `memory_reserved` (sampled at every reserved /
+    stats query and at `_sample_all`)."""
+    dev = _resolve(device)
+    cur = memory_reserved(dev)
+    return max(_reserved_peaks.get(_key(dev), 0), cur)
 
 
 def reset_max_memory_allocated(device=None):
-    """Reset the fallback high-water mark to the current allocation.
-    (Backend-reported peaks are owned by PJRT and cannot be reset.)"""
+    """Restart the allocation high-water mark at the CURRENT allocation.
+    Backend-reported peaks are owned by PJRT and cannot be reset, so a
+    baseline snapshot of the backend peak is kept and
+    `max_memory_allocated` reports against it."""
     dev = _resolve(device)
-    _peaks[_key(dev)] = _live_bytes(dev)
+    k = _key(dev)
+    st = _backend_stats(dev)
+    if st and "peak_bytes_in_use" in st:
+        _peak_baseline[k] = int(st["peak_bytes_in_use"])
+        _peaks[k] = int(st.get("bytes_in_use", 0))
+    else:
+        _peaks[k] = _live_bytes(dev)
 
 
 def reset_peak_memory_stats(device=None):
     reset_max_memory_allocated(device)
+    _reserved_peaks.pop(_key(_resolve(device)), None)
 
 
 def memory_stats(device=None) -> dict:
@@ -179,6 +217,12 @@ def _sample_all(_op_name=None, _outs=None):
         st = _backend_stats(dev)
         if st and "bytes_in_use" in st:
             with_stats.append((dev, int(st["bytes_in_use"])))
+            for rk in ("bytes_reserved", "pool_bytes", "bytes_limit"):
+                if rk in st:
+                    k = _key(dev)
+                    if int(st[rk]) > _reserved_peaks.get(k, 0):
+                        _reserved_peaks[k] = int(st[rk])
+                    break
         else:
             fallback[dev] = 0
     if fallback:

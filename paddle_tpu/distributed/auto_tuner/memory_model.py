@@ -64,9 +64,10 @@ def estimate_bytes_per_device(cfg: Dict, model_cfg: Dict, *,
     act_bytes = (mbs * seq_len * h * layers_here * act_mult
                  * bytes_per_param / mp)
     # pipeline keeps up to S in-flight micro-batches of boundary
-    # activations
+    # activations; TP splits those wide boundary tensors like the other
+    # activations, so the term is divided by mp
     if pp > 1:
-        act_bytes += mbs * seq_len * h * pp * bytes_per_param
+        act_bytes += mbs * seq_len * h * pp * bytes_per_param / mp
     return int(param_bytes + grad_bytes + opt_bytes + act_bytes)
 
 

@@ -127,15 +127,28 @@ class Recorder:
     def add(self, cfg: Dict, result: Dict):
         self.history.append({**cfg, **result})
 
+    @staticmethod
+    def _comparable(ok: List[Dict]) -> List[Dict]:
+        """pp trials time a different program (MLP-stage scan_pipeline, not
+        the tiny-llama the dp/mp trials train), so when the history mixes
+        both, pp results are excluded from ranking rather than compared
+        apples-to-oranges (ADVICE r5 medium)."""
+        if any(h.get("pp_degree", 1) == 1 for h in ok) and \
+                any(h.get("pp_degree", 1) > 1 for h in ok):
+            return [h for h in ok if h.get("pp_degree", 1) == 1]
+        return ok
+
     def best(self) -> Optional[Dict]:
         ok = [h for h in self.history if h.get("error") is None]
         if not ok:
             return None
+        ok = self._comparable(ok)
         return (max if self.maximize else min)(
             ok, key=lambda h: h[self.metric])
 
     def sorted(self) -> List[Dict]:
-        ok = [h for h in self.history if h.get("error") is None]
+        ok = self._comparable(
+            [h for h in self.history if h.get("error") is None])
         return sorted(ok, key=lambda h: h[self.metric],
                       reverse=self.maximize)
 

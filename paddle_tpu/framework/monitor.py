@@ -13,8 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-__all__ = ["register_counter", "counter", "inc", "set_value", "get",
-           "get_all", "reset", "reset_all", "Counter"]
+__all__ = ["register_counter", "counter", "inc", "set_value", "set_max",
+           "get", "get_all", "reset", "reset_all", "Counter"]
 
 
 class Counter:
@@ -35,6 +35,13 @@ class Counter:
     def set(self, value):
         with self._lock:
             self._value = value
+
+    def set_max(self, value):
+        """High-water-mark update (used for e.g. largest fused region)."""
+        with self._lock:
+            if value > self._value:
+                self._value = value
+        return self._value
 
     def get(self):
         return self._value
@@ -66,6 +73,10 @@ def inc(name: str, delta=1):
 
 def set_value(name: str, value):
     register_counter(name).set(value)
+
+
+def set_max(name: str, value):
+    return register_counter(name).set_max(value)
 
 
 def get(name: str):

@@ -366,4 +366,30 @@ class Profiler:
             if counters:
                 lines.append("Monitor counters: " + ", ".join(
                     f"{k}={v}" for k, v in counters.items()))
+        lines.extend(self._lazy_summary_lines())
         return "\n".join(lines)
+
+    @staticmethod
+    def _lazy_summary_lines():
+        """Lazy eager-region stats (core/lazy.py): how many flushes ran in
+        the profiled window, why, and how large the fused regions were —
+        the `lazy_region_flush[...]` host spans above are the per-flush
+        timings."""
+        from ..framework import monitor
+
+        flushes = monitor.get("lazy.flushes")
+        if not flushes:
+            return []
+        fused = monitor.get("lazy.fused_ops")
+        reasons = {k[len("lazy.flushes."):]: v
+                   for k, v in monitor.get_all().items()
+                   if k.startswith("lazy.flushes.") and v}
+        return [
+            "",
+            f"Lazy eager regions: {flushes} flushes, {fused} ops fused "
+            f"(avg {fused / max(flushes, 1):.1f}/region, "
+            f"max {monitor.get('lazy.max_region_ops')}), "
+            f"fused-backward {monitor.get('lazy.fused_backward')}",
+            "Flush reasons: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(reasons.items())),
+        ]

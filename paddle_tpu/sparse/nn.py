@@ -153,7 +153,11 @@ def _rulebook(coords, dense_spatial, ksize, stride, padding, subm,
     offsets = np.stack(np.meshgrid(
         *[np.arange(k) for k in ksize], indexing="ij"),
         axis=-1).reshape(-1, ndim) * np.asarray(dilation)
-    out_size = _out_size(dense_spatial, ksize, stride, padding, dilation)
+    # subm outputs live at INPUT sites: bound-check against the input
+    # spatial extent (the formula extent can exceed it for even kernels,
+    # which used to let phantom sites steal contributions)
+    out_size = list(dense_spatial) if subm else \
+        _out_size(dense_spatial, ksize, stride, padding, dilation)
     # conv relation: out = (in + pad - dilation*off) / stride
     for off in offsets:
         shifted = coords[:, 1:] + np.asarray(padding) - off
@@ -202,6 +206,13 @@ def _sparse_conv(x: SparseTensor, weight, bias, stride, padding, subm,
     ksize = tuple(int(s) for s in w_arr.shape[:ndim])
     stride, padding = _tup(stride, ndim), _tup(padding, ndim)
     dilation = _tup(dilation, ndim)
+    if subm:
+        # submanifold geometry is fixed by definition (output sites == input
+        # sites): stride 1 and centered padding dilation*(k//2) per dim, as
+        # the reference kernel enforces — user-passed stride/padding used to
+        # leak in and silently zero rows at upper-boundary sites.
+        stride = (1,) * ndim
+        padding = tuple(dilation[d] * (ksize[d] // 2) for d in range(ndim))
     groups = int(groups)
     c_in = int(vals.shape[-1])
     if c_in % groups or int(w_arr.shape[-1]) % groups:
