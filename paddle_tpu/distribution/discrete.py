@@ -225,10 +225,15 @@ class Binomial(Distribution):
 
     def sample(self, shape=(), key=None):
         import jax
+        import jax.numpy as jnp
 
         shp = self._extend_shape(shape)
-        out = jax.random.binomial(self._key(key), self.total_count,
-                                  self.probs, shape=shp)
+        # cast to the default float width: jax.random.binomial's internal
+        # clamp constants are default-float, and x64 + float32 probs trips
+        # lax.clamp's same-dtype check
+        ft = jnp.result_type(float)
+        out = jax.random.binomial(self._key(key), ft.type(self.total_count),
+                                  jnp.asarray(self.probs, ft), shape=shp)
         return Tensor(out.astype("int64"))
 
     def log_prob(self, value):

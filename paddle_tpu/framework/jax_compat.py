@@ -1,0 +1,58 @@
+"""Version shims for jax APIs that moved between releases.
+
+The repo targets current jax idioms (`jax.shard_map` with
+`check_vma`/`axis_names`, `pltpu.CompilerParams`); older builds spell
+those `jax.experimental.shard_map.shard_map` with `check_rep`/`auto` and
+`pltpu.TPUCompilerParams`. Route through here instead of sprinkling
+hasattr checks at call sites.
+"""
+from __future__ import annotations
+
+__all__ = ["axis_size", "shard_map", "tpu_compiler_params"]
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` when present; else `psum(1, axis)`, which
+    constant-folds to a static python int inside shard_map bodies."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, axis_names=None,
+              **kw):
+    """`jax.shard_map` when present, else the experimental spelling with
+    `check_vma` -> `check_rep` translation.
+
+    `axis_names={manual}` (partial-manual, other axes stay GSPMD-automatic)
+    has no working old-jax equivalent: the experimental `auto=` produces
+    programs XLA's SPMD partitioner rejects (PartitionId). Old jax instead
+    goes FULL-manual over every mesh axis — identical semantics whenever
+    the in/out specs don't shard over the would-be-auto axes (the body's
+    collectives name only the manual axes either way), which covers every
+    in-tree caller; GSPMD-composed sharding over the auto axes is a
+    new-jax feature."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams` (new) / `pltpu.TPUCompilerParams` (old)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

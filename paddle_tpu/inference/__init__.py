@@ -12,7 +12,7 @@ Components:
   prefill+decode programs with the Pallas paged-attention kernel
   (llama_runner.py).
 """
-from .cache import BlockCacheManager
+from .cache import BlockCacheManager, KVCacheExhausted, SequenceTooLong
 from .llama_runner import GenerationConfig, LlamaInferenceEngine
 from .predictor import (Config, DataType, PlaceType, Predictor,
                         PredictorTensor, create_predictor, get_version)
@@ -20,5 +20,6 @@ from .predictor import (Config, DataType, PlaceType, Predictor,
 __all__ = [
     "Config", "DataType", "PlaceType", "Predictor", "PredictorTensor",
     "create_predictor", "get_version", "BlockCacheManager",
+    "KVCacheExhausted", "SequenceTooLong",
     "GenerationConfig", "LlamaInferenceEngine",
 ]

@@ -6,6 +6,11 @@ block_multi_head_attention_kernel.cu`): device memory is a pool of
 fixed-size blocks; each sequence holds a block table mapping logical block
 index → physical block id. Allocation/free is O(1) host bookkeeping —
 device arrays never reallocate, which keeps XLA programs static-shaped.
+
+Exhaustion is a *scheduling event*, not a crash: `allocate`/`append_token`
+raise the typed `KVCacheExhausted` (pool empty) or `SequenceTooLong`
+(per-sequence block cap), which the continuous-batching scheduler
+(`paddle_tpu.serving.scheduler`) consumes to queue or preempt requests.
 """
 from __future__ import annotations
 
@@ -13,7 +18,40 @@ from typing import Dict, List
 
 import numpy as np
 
-__all__ = ["BlockCacheManager"]
+__all__ = ["BlockCacheManager", "KVCacheExhausted", "SequenceTooLong"]
+
+
+class KVCacheExhausted(RuntimeError):
+    """The physical block pool has no free block.
+
+    Recoverable by design: the serving scheduler catches this to delay
+    admission or preempt a running sequence (blocks come back via `free`).
+    Subclasses RuntimeError so pre-existing callers keep working.
+    """
+
+    def __init__(self, need: int, free: int, total: int):
+        self.need = need
+        self.free = free
+        self.total = total
+        super().__init__(
+            f"KV cache pool exhausted: need {need} block(s), "
+            f"{free}/{total} free")
+
+
+class SequenceTooLong(ValueError):
+    """A single sequence asked for more than `max_blocks_per_seq` blocks.
+
+    Unlike `KVCacheExhausted` this is not recoverable by waiting — the
+    request can never fit and must be rejected (or its generation capped).
+    Subclasses ValueError so pre-existing callers keep working.
+    """
+
+    def __init__(self, need_blocks: int, max_blocks: int):
+        self.need_blocks = need_blocks
+        self.max_blocks = max_blocks
+        super().__init__(
+            f"sequence needs {need_blocks} blocks > max_blocks_per_seq "
+            f"{max_blocks}")
 
 
 class BlockCacheManager:
@@ -30,19 +68,34 @@ class BlockCacheManager:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def num_seqs(self) -> int:
+        return len(self._tables)
+
+    def utilization(self) -> float:
+        """Fraction of the physical pool currently held by sequences."""
+        return (self.num_blocks - len(self._free)) / max(self.num_blocks, 1)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return max(1, (num_tokens + self.block_size - 1) // self.block_size)
+
     def can_allocate(self, num_tokens: int) -> bool:
-        need = (num_tokens + self.block_size - 1) // self.block_size
-        return len(self._free) >= need
+        return len(self._free) >= self.blocks_needed(num_tokens)
 
     def allocate(self, seq_id: int, num_tokens: int) -> List[int]:
-        """Reserve blocks for a new sequence of `num_tokens` tokens."""
+        """Reserve blocks for a new sequence of `num_tokens` tokens.
+
+        Raises `SequenceTooLong` (never fits) or `KVCacheExhausted`
+        (fits once blocks are freed) — never asserts: the serving path
+        turns both into admission-control decisions.
+        """
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
-        need = max(1, (num_tokens + self.block_size - 1) // self.block_size)
+        need = self.blocks_needed(num_tokens)
         if need > self.max_blocks_per_seq:
-            raise ValueError("sequence exceeds max_blocks_per_seq")
+            raise SequenceTooLong(need, self.max_blocks_per_seq)
         if need > len(self._free):
-            raise RuntimeError("KV cache pool exhausted")
+            raise KVCacheExhausted(need, len(self._free), self.num_blocks)
         blocks = [self._free.pop() for _ in range(need)]
         self._tables[seq_id] = blocks
         self._lens[seq_id] = num_tokens
@@ -50,14 +103,29 @@ class BlockCacheManager:
 
     def append_token(self, seq_id: int) -> None:
         """Account one generated token; grows the table on block boundary."""
-        n = self._lens[seq_id] = self._lens[seq_id] + 1
+        n = self._lens[seq_id] + 1
         table = self._tables[seq_id]
         if n > len(table) * self.block_size:
             if len(table) >= self.max_blocks_per_seq:
-                raise ValueError("sequence exceeds max_blocks_per_seq")
+                raise SequenceTooLong(len(table) + 1, self.max_blocks_per_seq)
             if not self._free:
-                raise RuntimeError("KV cache pool exhausted")
+                raise KVCacheExhausted(1, 0, self.num_blocks)
             table.append(self._free.pop())
+        self._lens[seq_id] = n
+
+    def trim(self, seq_id: int, num_tokens: int) -> None:
+        """Shrink a sequence to `num_tokens` tokens, returning surplus
+        blocks to the pool. Used after bucket-padded prefill: the engine
+        prefills at a padded length (bounded compile count), then the real
+        prompt length is restored here so the padding blocks don't stay
+        leased."""
+        if num_tokens > self._lens[seq_id]:
+            raise ValueError("trim can only shrink a sequence")
+        keep = self.blocks_needed(num_tokens)
+        table = self._tables[seq_id]
+        while len(table) > keep:
+            self._free.append(table.pop())
+        self._lens[seq_id] = num_tokens
 
     def free(self, seq_id: int) -> None:
         for b in self._tables.pop(seq_id):

@@ -172,15 +172,29 @@ class LlamaInferenceEngine:
         self._decode = jax.jit(functools.partial(
             _decode_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
 
-    # ---- public API ----
-    def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray):
-        """input_ids [B, S] int32; returns last-token logits [B, V]."""
+    # ---- public API (the serving EngineCore surface) ----
+    def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
+                lens: Optional[np.ndarray] = None):
+        """input_ids [B, S] int32; returns next-token logits [B, V].
+
+        `lens` [B] gives the true prompt length per row when `input_ids` is
+        right-padded (the serving scheduler pads prompts to a small set of
+        bucket lengths so prefill compiles O(log S) programs, not one per
+        prompt length); logits are gathered at position `lens-1`. Padded
+        positions do write (garbage) KV into the sequence's own padded
+        block allocation — callers trim via `BlockCacheManager.trim`, and
+        decode overwrites position `lens` onward, so the garbage is never
+        attended to."""
         import jax.numpy as jnp
 
+        b, s = np.asarray(input_ids).shape
+        if lens is None:
+            lens = np.full((b,), s, np.int32)
         logits, self.k_cache, self.v_cache = self._prefill(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(input_ids, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32))
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(lens, jnp.int32))
         return logits
 
     def decode_step(self, tokens: np.ndarray, context_lens: np.ndarray,
@@ -356,21 +370,32 @@ def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
     return logits, new_k, new_v
 
 
-def _prefill_fn(params, k_cache, v_cache, input_ids, tables, *, cfg):
+def _prefill_fn(params, k_cache, v_cache, input_ids, tables, lens, *, cfg):
     import jax.numpy as jnp
 
+    from ..framework import monitor
+
+    # Trace-time side effect: bumps once per (re)trace, never at run time —
+    # the serving tests assert this stays flat after warmup.
+    monitor.inc("serving.prefill_retraces")
     b, s = input_ids.shape
     x = jnp.take(params["embed"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     ctx = jnp.full((b,), s, jnp.int32)
     logits, nk, nv = _run_stack(params, k_cache, v_cache, x, positions,
                                 tables, ctx, cfg, decode=False)
-    return logits[:, -1, :].astype(jnp.float32), nk, nv
+    idx = jnp.clip(lens - 1, 0, s - 1)
+    last = jnp.take_along_axis(
+        logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :]
+    return last.astype(jnp.float32), nk, nv
 
 
 def _decode_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
     import jax.numpy as jnp
 
+    from ..framework import monitor
+
+    monitor.inc("serving.decode_retraces")  # trace-time only (see prefill)
     b = tokens.shape[0]
     x = jnp.take(params["embed"], tokens[:, None], axis=0)
     positions = (ctx_lens - 1)[:, None].astype(jnp.int32)   # [B, 1]

@@ -109,7 +109,15 @@ class Config:
         self._cpu_math_threads = int(n)
 
     def enable_profile(self):
+        """Profile `Predictor.run`: the predictor starts a host-span
+        `paddle_tpu.profiler.Profiler` and wraps every run in a
+        `Predictor.run` span (+ per-op dispatch spans); read results via
+        `Predictor.profiler_summary()`. Reference: AnalysisConfig
+        EnableProfile -> per-run timeline."""
         self._enable_profile = True
+
+    def disable_profile(self):
+        self._enable_profile = False
 
     def summary(self) -> Dict[str, object]:
         return dict(model=self._model_path, device=self._device or "default",
@@ -166,6 +174,15 @@ class Predictor:
         self._inputs: Dict[str, object] = {}
         self._outputs: List[object] = []
         self._output_names: List[str] = []
+        # Config.enable_profile() -> host-span profiler around every run
+        # (CPU target only: the device timeline is opt-in via a user-owned
+        # Profiler, not a config flag). Started/stopped per run so the
+        # process-global dispatch hook is never left installed between runs.
+        self._profiler = None
+        if config._enable_profile:
+            from ..profiler import Profiler, ProfilerTarget
+
+            self._profiler = Profiler(targets=[ProfilerTarget.CPU])
 
     # --- reference API surface ---
     def get_input_names(self) -> List[str]:
@@ -188,7 +205,28 @@ class Predictor:
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. With `inputs`, behaves like the reference's
         list-in/list-out convenience; else uses handles set via
-        copy_from_cpu."""
+        copy_from_cpu. With `Config.enable_profile()`, each run emits a
+        `Predictor.run` host span plus a profiler step."""
+        if self._profiler is None:
+            return self._run_impl(inputs)
+        from ..profiler import RecordEvent
+
+        self._profiler.start()   # recorder accumulates across runs
+        try:
+            with RecordEvent("Predictor.run"):
+                out = self._run_impl(inputs)
+        finally:
+            self._profiler.stop()
+        return out
+
+    def profiler_summary(self) -> str:
+        """Aggregated span table for the profiled runs (requires
+        `Config.enable_profile()`)."""
+        if self._profiler is None:
+            return "profiling not enabled (Config.enable_profile())"
+        return self._profiler.summary()
+
+    def _run_impl(self, inputs: Optional[List[np.ndarray]] = None):
         from ..core.tensor import Tensor
 
         if inputs is not None:

@@ -22,6 +22,15 @@ from .to_static import InputSpec, StaticFunction
 __all__ = ["save", "load", "TranslatedLayer"]
 
 
+def _jax_export():
+    """The `jax.export` module. `import jax; jax.export` trips the module
+    deprecation gate on some jax builds even though the submodule imports
+    fine — go through the import system instead of attribute access."""
+    from jax import export
+
+    return export
+
+
 def _specs_to_avals(input_spec, example_inputs=None):
     import jax
 
@@ -39,9 +48,9 @@ def _specs_to_avals(input_spec, example_inputs=None):
                 # constrained equal.
                 if any(s is None or s < 0 for s in spec.shape):
                     if scope is None:
-                        scope = jax.export.SymbolicScope()
+                        scope = _jax_export().SymbolicScope()
                     shape = tuple(
-                        jax.export.symbolic_shape(f"d{arg_idx}_{i}",
+                        _jax_export().symbolic_shape(f"d{arg_idx}_{i}",
                                                   scope=scope)[0]
                         if (s is None or s < 0) else int(s)
                         for i, s in enumerate(spec.shape))
@@ -91,7 +100,7 @@ def save(layer, path: str, input_spec=None, **configs):
                        for k, v in params.items()}
         buffer_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                         for k, v in buffers.items()}
-        exported = jax.export.export(jax.jit(pure_fn))(
+        exported = _jax_export().export(jax.jit(pure_fn))(
             param_avals, buffer_avals, *avals)
         blob = exported.serialize()
         meta = {
@@ -141,7 +150,7 @@ class TranslatedLayer(Layer):
         super().__init__()
         import jax
 
-        self._exported = jax.export.deserialize(
+        self._exported = _jax_export().deserialize(
             bytearray(meta["stablehlo"]))
         self._meta = meta
         self._params = {k: weights[k]._data if isinstance(weights[k], Tensor)

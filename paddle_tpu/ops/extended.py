@@ -821,8 +821,12 @@ def poisson(x, name=None):
 
 def binomial(count, prob, name=None):
     count, prob = prep_binary(count, prob)
+    # jax.random.binomial clamps against default-float constants: under
+    # x64 they are float64, so float32 inputs trip lax.clamp's same-dtype
+    # check — compute at the default float width instead.
     _reg("binomial_op", lambda key, n, p: jax.random.binomial(
-        key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64))
+        key, n.astype(jnp.result_type(float)),
+        p.astype(jnp.result_type(float))).astype(jnp.int64))
     return dispatch.apply("binomial_op", [_key_tensor(), count, prob])
 
 

@@ -48,7 +48,11 @@ def x64_off():
     """
     import jax
 
-    return jax.enable_x64(False)
+    if hasattr(jax, "enable_x64"):         # older jax: top-level
+        return jax.enable_x64(False)
+    from jax.experimental import enable_x64
+
+    return enable_x64(False)
 
 
 def pallas_call(*args, **kwargs):

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import _support
+from ...framework import jax_compat as _jax_compat
 
 NEG_INF = -1e30
 
@@ -219,7 +220,9 @@ def _fa_forward(q, k, v, causal, sm_scale, kv_lens=None):
     hk, sk = k.shape[1], k.shape[2]
     if _needs_stream(sk, d, q.dtype.itemsize):
         return _fa_forward_streamed(q, k, v, causal, sm_scale, kv_lens)
-    group = h // hk
+    # np.int32: a python-int divisor in BlockSpec index maps weak-types
+    # to i64 when interpret-mode tracing runs under an x64-on program
+    group = np.int32(h // hk)
     bq, bk = _blocks(sq, sk)
     interp = _support.interpret_mode()
     lens, use_lens = _prep_lens(kv_lens)
@@ -271,7 +274,9 @@ def _flash_bwd_rule(causal, sm_scale, res, g):
     q, k, v, kv_lens, out, lse = res
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
-    group = h // hk
+    # np.int32: a python-int divisor in BlockSpec index maps weak-types
+    # to i64 when interpret-mode tracing runs under an x64-on program
+    group = np.int32(h // hk)
     bq, bk = _blocks(sq, sk)
     interp = _support.interpret_mode()
     lens, use_lens = _prep_lens(kv_lens)
@@ -479,7 +484,9 @@ def _fwd_stream_kernel(*refs, sm_scale, causal, block_q, block_k, n_k,
 def _fa_forward_streamed(q, k, v, causal, sm_scale, kv_lens=None):
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
-    group = h // hk
+    # np.int32: a python-int divisor in BlockSpec index maps weak-types
+    # to i64 when interpret-mode tracing runs under an x64-on program
+    group = np.int32(h // hk)
     bq = _support.pick_block(sq)
     bk = _support.pick_block(sk, 512)
     n_k = sk // bk
@@ -514,7 +521,7 @@ def _fa_forward_streamed(q, k, v, causal, sm_scale, kv_lens=None):
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -652,7 +659,9 @@ def _flash_bwd_streamed(q, k, v, g, lse, delta, lens, use_lens, causal,
                         sm_scale):
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
-    group = h // hk
+    # np.int32: a python-int divisor in BlockSpec index maps weak-types
+    # to i64 when interpret-mode tracing runs under an x64-on program
+    group = np.int32(h // hk)
     bq = _support.pick_block(sq)
     bk = _support.pick_block(sk, 512)
     interp = _support.interpret_mode()
@@ -683,7 +692,7 @@ def _flash_bwd_streamed(q, k, v, g, lse, delta, lens, use_lens, causal,
                                lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interp,
@@ -721,7 +730,7 @@ def _flash_bwd_streamed(q, k, v, g, lse, delta, lens, use_lens, causal,
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interp,

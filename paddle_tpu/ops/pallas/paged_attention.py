@@ -58,7 +58,9 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
                                 preferred_element_type=jnp.float32)  # (G, BS)
         pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx_len, s, NEG_INF)
+        # typed scalar: a python-float NEG_INF weak-types to f64 when the
+        # interpret-mode kernel is traced inside an x64-on outer program
+        s = jnp.where(pos < ctx_len, s, jnp.float32(NEG_INF))
         m_prev = m_ref[...][:, 0]
         l_prev = l_ref[...][:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
@@ -73,7 +75,7 @@ def _decode_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == nb - 1)
     def _finish():
         l = l_ref[...][:, 0]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
         o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 

@@ -23,6 +23,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import _support
+from ...framework import jax_compat as _jax_compat
 
 
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k):
@@ -86,7 +87,7 @@ def _build_qmm(m, n, k, out_dtype, cfg):
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         # f32 accumulator carried across the K grid axis
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_support.interpret_mode(),
     )

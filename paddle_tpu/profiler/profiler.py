@@ -367,6 +367,7 @@ class Profiler:
                 lines.append("Monitor counters: " + ", ".join(
                     f"{k}={v}" for k, v in counters.items()))
         lines.extend(self._lazy_summary_lines())
+        lines.extend(self._serving_summary_lines())
         return "\n".join(lines)
 
     @staticmethod
@@ -393,3 +394,46 @@ class Profiler:
             "Flush reasons: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(reasons.items())),
         ]
+
+    @staticmethod
+    def _serving_summary_lines():
+        """Continuous-batching serving stats (serving/metrics.py): request
+        outcomes, token throughput counters, latency percentiles, and the
+        retrace counters that must stay flat in steady state."""
+        from ..framework import monitor
+
+        g = monitor.get
+        if not g("serving.requests_submitted"):
+            return []
+        rejected = {k[len("serving.rejected."):]: v
+                    for k, v in monitor.get_all().items()
+                    if k.startswith("serving.rejected.") and v}
+        lines = [
+            "",
+            f"Serving: {g('serving.requests_submitted')} submitted, "
+            f"{g('serving.requests_completed')} completed, "
+            f"{g('serving.requests_rejected')} rejected, "
+            f"{g('serving.requests_timed_out')} timed out, "
+            f"{g('serving.requests_cancelled')} cancelled, "
+            f"{g('serving.preemptions')} preemptions",
+            f"  tokens: {g('serving.tokens_generated')} generated over "
+            f"{g('serving.decode_steps')} decode steps "
+            f"(+{g('serving.prefill_tokens')} prefill tokens / "
+            f"{g('serving.prefills')} prefills); retraces: "
+            f"prefill={g('serving.prefill_retraces')}, "
+            f"decode={g('serving.decode_retraces')}",
+            f"  occupancy avg {g('serving.batch_occupancy_avg_pct')}%, "
+            f"KV util {g('serving.kv_utilization_pct')}% "
+            f"(peak {g('serving.kv_utilization_peak_pct')}%), "
+            f"queue depth {g('serving.queue_depth')} "
+            f"(peak {g('serving.queue_depth_peak')})",
+        ]
+        if g("serving.ttft_p50_ms"):
+            lines.append(
+                f"  TTFT p50 {g('serving.ttft_p50_ms')} ms / "
+                f"p99 {g('serving.ttft_p99_ms')} ms, "
+                f"TPOT mean {g('serving.tpot_mean_ms')} ms")
+        if rejected:
+            lines.append("  reject reasons: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(rejected.items())))
+        return lines

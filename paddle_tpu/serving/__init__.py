@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — continuous-batching inference serving (L9+).
+
+The reference ships a generic optimized inference engine plus a serving C
+API (`paddle/fluid/inference/api/`, `paddle/fluid/inference/capi_exp/`);
+this package is its TPU-native serving layer over the paged-KV decode
+stack, shaped by the Ragged-Paged-Attention observation (PAPERS.md): keep
+ONE fixed-shape decode program over a ragged batch of sequences with
+per-sequence block tables, and let host-side scheduling — not XLA
+recompilation — absorb all request churn.
+
+Components:
+- `EngineCore` (engine.py): the model-agnostic prefill/decode protocol
+  (stacked params + paged KV + fixed max-batch decode step).
+  `LlamaInferenceEngine` is the flagship implementation; `MLPLMEngine`
+  is a deliberately tiny second model family proving the scheduler is
+  model-agnostic.
+- `Scheduler` (scheduler.py): continuous batching — admits queued
+  requests into decode slots, evicts finished sequences mid-batch,
+  preempts on `KVCacheExhausted`, keeps decode shape-stable (zero
+  recompiles in steady state).
+- `ServingFrontend` (frontend.py): submit/stream/cancel with deadlines,
+  admission control (reject-with-reason, never crash), token callbacks.
+- `ServingMetrics` (metrics.py): TTFT/TPOT, queue depth, batch occupancy,
+  KV utilization, preemptions — published to `framework.monitor` and
+  rendered by `profiler.summary()`.
+"""
+from .engine import EngineCore, MLPLMEngine
+from .frontend import RequestHandle, ServingFrontend
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
+
+__all__ = [
+    "EngineCore", "MLPLMEngine", "Request", "RequestHandle",
+    "RequestStatus", "SamplingParams", "Scheduler", "ServingFrontend",
+    "ServingMetrics",
+]

@@ -1,0 +1,174 @@
+"""EngineCore — the model-agnostic serving engine protocol.
+
+Generalizes `inference.llama_runner.LlamaInferenceEngine` into the contract
+the continuous-batching scheduler programs against. An engine owns stacked
+model params and a paged KV(-like) cache and exposes exactly two compiled
+entry points:
+
+- `prefill(input_ids [B, S], block_tables [B, MAXB], lens [B])` — run the
+  prompt, write the cache through the block tables, return next-token
+  logits [B, V] gathered at `lens-1` (rows may be right-padded to a bucket
+  length so the compile count is O(#buckets), not O(#prompt lengths));
+- `decode_step(tokens [B], context_lens [B], block_tables [B, MAXB])` —
+  one fixed-shape step over the ragged batch (B == max_batch_size always;
+  the scheduler pads empty slots), returning logits [B, V].
+
+Both must be shape-stable so the serving steady state never recompiles
+(the Ragged-Paged-Attention shape discipline, PAPERS.md). Engines bump
+`monitor.inc("serving.prefill_retraces"/"serving.decode_retraces")` at
+TRACE time inside their jitted fns so tests can assert exactly that.
+
+`MLPLMEngine` is the second, deliberately tiny implementation: a bag-of-
+embeddings MLP language model whose "KV" cache stores per-token embeddings
+in the same paged layout. It exists to prove the scheduler/frontend stack
+is model-agnostic (2-model genericity test), and doubles as a fast CPU
+smoke engine.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Protocol, runtime_checkable
+
+import numpy as np
+
+from ..inference.cache import BlockCacheManager
+
+__all__ = ["EngineCore", "MLPLMEngine"]
+
+
+@runtime_checkable
+class EngineCore(Protocol):
+    """Structural protocol: `LlamaInferenceEngine` satisfies it as-is."""
+
+    max_batch_size: int
+    manager: BlockCacheManager
+
+    def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
+                lens: Optional[np.ndarray] = None) -> np.ndarray:
+        ...
+
+    def decode_step(self, tokens: np.ndarray, context_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        ...
+
+
+def _mlp_prefill(params, cache, input_ids, tables, lens, *, block_size):
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.prefill_retraces")  # trace-time only
+    b, s = input_ids.shape
+    x = jnp.take(params["embed"], input_ids, axis=0)        # [B, S, D]
+    pos = jnp.arange(s, dtype=jnp.int32)
+    blocks = jnp.take_along_axis(tables, (pos // block_size)[None, :],
+                                 axis=1)                     # [B, S]
+    offs = jnp.broadcast_to(pos % block_size, (b, s))
+    cache = cache.at[blocks.reshape(-1), offs.reshape(-1)].set(
+        x.reshape(b * s, -1))
+    mask = (pos[None, :] < lens[:, None]).astype(x.dtype)    # [B, S]
+    mean = (x * mask[..., None]).sum(1) / jnp.maximum(
+        mask.sum(1, keepdims=True), 1.0)
+    idx = jnp.clip(lens - 1, 0, s - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    logits = _mlp_head(params, last, mean)
+    return logits.astype(jnp.float32), cache
+
+
+def _mlp_decode(params, cache, tokens, ctx_lens, tables, *, block_size):
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.decode_retraces")  # trace-time only
+    b = tokens.shape[0]
+    maxb = tables.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)            # [B, D]
+    pos = jnp.maximum(ctx_lens - 1, 0)
+    blocks = jnp.take_along_axis(tables, (pos // block_size)[:, None],
+                                 axis=1)[:, 0]
+    cache = cache.at[blocks, pos % block_size].set(x)
+    window = jnp.take(cache, tables.reshape(-1), axis=0).reshape(
+        b, maxb * block_size, -1)                            # [B, W, D]
+    wpos = jnp.arange(maxb * block_size, dtype=jnp.int32)
+    mask = (wpos[None, :] < ctx_lens[:, None]).astype(x.dtype)
+    mean = (window * mask[..., None]).sum(1) / jnp.maximum(
+        mask.sum(1, keepdims=True), 1.0)
+    logits = _mlp_head(params, x, mean)
+    return logits.astype(jnp.float32), cache
+
+
+def _mlp_head(params, last, mean):
+    import jax
+    import jax.numpy as jnp
+
+    h = jnp.concatenate([last, mean], axis=-1)
+    h = jax.nn.gelu(h @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+class MLPLMEngine:
+    """Bag-of-embeddings MLP LM over the paged cache (EngineCore #2).
+
+    The "KV" cache is [num_blocks, block_size, D] token embeddings; decode
+    conditions on (last-token embedding, masked mean of the context window
+    gathered through the block table). Same paged bookkeeping, same
+    fixed-shape decode discipline as the Llama engine, ~1000x smaller.
+    """
+
+    def __init__(self, vocab_size: int = 256, hidden: int = 32,
+                 max_batch_size: int = 8, num_blocks: int = 64,
+                 block_size: int = 8, max_blocks_per_seq: int = 8,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.vocab_size = vocab_size
+        self.max_batch_size = max_batch_size
+        self.block_size = block_size
+        self.manager = BlockCacheManager(num_blocks, block_size,
+                                         max_blocks_per_seq)
+        rng = np.random.default_rng(seed)
+        d = hidden
+
+        def init(*shape):
+            return jnp.asarray(rng.normal(0, 0.08, shape), jnp.float32)
+
+        self.params = {
+            "embed": init(vocab_size, d),
+            "w1": init(2 * d, 2 * d), "b1": jnp.zeros((2 * d,), jnp.float32),
+            "w2": init(2 * d, vocab_size),
+            "b2": jnp.zeros((vocab_size,), jnp.float32),
+        }
+        self.cache = jnp.zeros((num_blocks, block_size, d), jnp.float32)
+        self._prefill = jax.jit(
+            functools.partial(_mlp_prefill, block_size=block_size),
+            donate_argnums=(1,))
+        self._decode = jax.jit(
+            functools.partial(_mlp_decode, block_size=block_size),
+            donate_argnums=(1,))
+
+    def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
+                lens: Optional[np.ndarray] = None) -> np.ndarray:
+        import jax.numpy as jnp
+
+        ids = np.asarray(input_ids, np.int32)
+        b, s = ids.shape
+        if lens is None:
+            lens = np.full((b,), s, np.int32)
+        logits, self.cache = self._prefill(
+            self.params, self.cache, jnp.asarray(ids),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(lens, jnp.int32))
+        return logits
+
+    def decode_step(self, tokens: np.ndarray, context_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32))
+        return logits

@@ -1,0 +1,142 @@
+"""ServingFrontend — submit/stream/cancel over the continuous-batching
+scheduler.
+
+The user-facing surface of the serving subsystem (the role of the
+reference's serving C API, `paddle/fluid/inference/capi_exp/pd_inference_api.h`,
+minus the C): callers submit token prompts and get back a `RequestHandle`
+they can poll, stream, or cancel. Degradation is graceful by construction —
+over-capacity submissions come back REJECTED with a reason string, expired
+deadlines come back TIMED_OUT, and the engine itself never sees a request
+the cache cannot hold.
+
+The frontend is synchronously driven: `step()` advances the world one
+scheduling round; `stream()` and `run_until_idle()` drive it for you.
+Single-threaded by design — TPU serving wants one driver loop feeding the
+fixed-shape decode program, not a thread per request.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional, Sequence
+
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
+
+__all__ = ["RequestHandle", "ServingFrontend"]
+
+
+class RequestHandle:
+    """Caller's view of one request."""
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def request_id(self) -> int:
+        return self._req.req_id
+
+    @property
+    def status(self) -> RequestStatus:
+        return self._req.status
+
+    @property
+    def finished(self) -> bool:
+        return self._req.status.terminal
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self._req.generated)
+
+    @property
+    def num_preemptions(self) -> int:
+        return self._req.num_preemptions
+
+    def ttft_ms(self) -> Optional[float]:
+        t = self._req.ttft()
+        return None if t is None else t * 1e3
+
+    def tpot_ms(self) -> Optional[float]:
+        t = self._req.tpot()
+        return None if t is None else t * 1e3
+
+    def __repr__(self):
+        return (f"RequestHandle(id={self.request_id}, "
+                f"status={self.status.value}, "
+                f"tokens={len(self._req.generated)}, "
+                f"reason={self.finish_reason})")
+
+
+class ServingFrontend:
+    def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
+                 max_queue: int = 256,
+                 default_timeout_s: Optional[float] = None):
+        self.metrics = metrics or ServingMetrics()
+        self.scheduler = Scheduler(engine, metrics=self.metrics,
+                                   max_queue=max_queue)
+        self.default_timeout_s = default_timeout_s
+
+    # ---- request API ----
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 16,
+               temperature: float = 0.0, top_k: int = 0,
+               eos_token_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               stream_cb=None, seed: int = 0) -> RequestHandle:
+        """Enqueue a generation request. NEVER raises on load conditions:
+        a request that cannot be served comes back already-terminal with
+        `finish_reason` in {prompt_too_long, queue_full, empty_prompt}."""
+        timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
+        now = time.perf_counter()
+        deadline = None if timeout_s is None else now + timeout_s
+        sp = SamplingParams(max_new_tokens=max_new_tokens,
+                            temperature=temperature, top_k=top_k,
+                            eos_token_id=eos_token_id, seed=seed)
+        cb = None
+        if stream_cb is not None:
+            cb = lambda req, tok, _cb=stream_cb: _cb(tok)  # noqa: E731
+        req = Request(prompt_ids, sampling=sp, deadline=deadline,
+                      stream_cb=cb)
+        self.scheduler.submit(req, now=now)
+        return RequestHandle(req)
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        return self.scheduler.cancel(handle._req)
+
+    # ---- driving ----
+    def step(self) -> int:
+        """Advance one scheduling round; returns tokens produced."""
+        return self.scheduler.step()
+
+    def run_until_idle(self, max_steps: int = 100000) -> int:
+        """Drive until every submitted request is terminal. Returns steps
+        taken. `max_steps` bounds runaway loops (a bug, not a load
+        condition — so it raises)."""
+        for n in range(max_steps):
+            if self.scheduler.idle:
+                return n
+            self.step()
+        if not self.scheduler.idle:
+            raise RuntimeError(f"not idle after {max_steps} steps")
+        return max_steps
+
+    def stream(self, handle: RequestHandle,
+               max_steps: int = 100000) -> Iterator[int]:
+        """Yield tokens for `handle` as they are produced, driving the
+        scheduler. Other in-flight requests advance on the same steps
+        (that's the point of continuous batching)."""
+        seen = 0
+        for _ in range(max_steps):
+            toks = handle._req.generated
+            while seen < len(toks):
+                yield toks[seen]
+                seen += 1
+            if handle.finished:
+                return
+            self.step()
+        raise RuntimeError(f"stream not finished after {max_steps} steps")
+
+    def summary(self) -> dict:
+        return self.metrics.summary()

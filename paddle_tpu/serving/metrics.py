@@ -1,0 +1,154 @@
+"""Serving observability: request/token counters, TTFT/TPOT latency,
+queue depth, batch occupancy, KV utilization, preemptions.
+
+Everything is double-published:
+- counters/gauges go to `framework.monitor` under the `serving.` prefix,
+  the same scrape surface the reference exposes via
+  `fluid/platform/monitor.h` stat registries — `profiler.summary()`
+  renders them as a serving section;
+- per-request latency samples stay in-process on `ServingMetrics` so
+  `summary()` can report p50/p99 TTFT and mean TPOT (percentiles can't
+  be rebuilt from monotonic counters).
+
+Retrace counters (`serving.prefill_retraces` / `serving.decode_retraces`)
+are bumped by the ENGINES at jit-trace time (see serving/engine.py); this
+module only reads them. In steady state they must stay flat.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..framework import monitor
+
+__all__ = ["ServingMetrics"]
+
+# Latency percentiles come from a bounded sliding window: a long-running
+# server must not grow sample lists (or pay O(all-requests) percentile
+# passes) forever.
+_WINDOW = 4096
+_PUBLISH_EVERY = 16
+
+
+def _pct(samples, q: float) -> Optional[float]:
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples, np.float64), q))
+
+
+class ServingMetrics:
+    """Collector owned by one Scheduler (monitor names are global: reset
+    with `reset_monitor()` when running several engines in-process)."""
+
+    def __init__(self):
+        self.ttft_s = deque(maxlen=_WINDOW)
+        self.tpot_s = deque(maxlen=_WINDOW)
+        self._occ_sum = 0.0
+        self._steps = 0
+        self._finishes = 0
+
+    def reset_window(self):
+        """Drop latency samples and the occupancy accumulator (e.g. at a
+        warmup/measurement boundary) without touching monitor counters."""
+        self.ttft_s.clear()
+        self.tpot_s.clear()
+        self._occ_sum = 0.0
+        self._steps = 0
+        self._finishes = 0
+
+    # ---- request lifecycle ----
+    def on_submit(self):
+        monitor.inc("serving.requests_submitted")
+
+    def on_reject(self, reason: str):
+        monitor.inc("serving.requests_rejected")
+        monitor.inc(f"serving.rejected.{reason}")
+
+    def on_preempt(self):
+        monitor.inc("serving.preemptions")
+
+    def on_prefill(self, num_tokens: int):
+        monitor.inc("serving.prefills")
+        monitor.inc("serving.prefill_tokens", num_tokens)
+
+    def on_first_token(self, req):
+        t = req.ttft()
+        if t is not None:
+            self.ttft_s.append(t)
+
+    def on_finish(self, req):
+        from .scheduler import RequestStatus
+
+        name = {RequestStatus.FINISHED: "serving.requests_completed",
+                RequestStatus.CANCELLED: "serving.requests_cancelled",
+                RequestStatus.TIMED_OUT: "serving.requests_timed_out"}.get(
+                    req.status)
+        if name:
+            monitor.inc(name)
+        t = req.tpot()
+        if t is not None:
+            self.tpot_s.append(t)
+        self._finishes += 1
+        # percentile passes are O(window): publish on the first finish
+        # (so gauges exist) then every few — summary() always recomputes
+        if self._finishes == 1 or self._finishes % _PUBLISH_EVERY == 0:
+            self._publish_latency()
+
+    # ---- step-level gauges ----
+    def on_decode(self, tokens: int):
+        monitor.inc("serving.decode_steps")
+        monitor.inc("serving.tokens_generated", tokens)
+
+    def on_step(self, occupancy: float, kv_utilization: float,
+                queue_depth: int, decoded: bool = True):
+        # occupancy averages over DECODE steps only — idle polling rounds
+        # (no sequence in flight) say nothing about batching efficiency
+        if decoded:
+            self._steps += 1
+            self._occ_sum += occupancy
+            monitor.set_value("serving.batch_occupancy_pct",
+                              round(occupancy * 100.0, 1))
+            monitor.set_value("serving.batch_occupancy_avg_pct",
+                              round(self._occ_sum / self._steps * 100.0, 1))
+        monitor.set_value("serving.kv_utilization_pct",
+                          round(kv_utilization * 100.0, 1))
+        monitor.set_max("serving.kv_utilization_peak_pct",
+                        round(kv_utilization * 100.0, 1))
+        monitor.set_value("serving.queue_depth", queue_depth)
+        monitor.set_max("serving.queue_depth_peak", queue_depth)
+
+    def gauge_queue(self, depth: int):
+        monitor.set_value("serving.queue_depth", depth)
+        monitor.set_max("serving.queue_depth_peak", depth)
+
+    def _publish_latency(self):
+        for name, val in (("serving.ttft_p50_ms", _pct(self.ttft_s, 50)),
+                          ("serving.ttft_p99_ms", _pct(self.ttft_s, 99)),
+                          ("serving.tpot_mean_ms",
+                           float(np.mean(self.tpot_s)) if self.tpot_s
+                           else None)):
+            if val is not None:
+                monitor.set_value(name, round(val * 1e3, 3))
+
+    # ---- reporting ----
+    def summary(self) -> Dict[str, object]:
+        out = {k: v for k, v in monitor.get_all().items()
+               if k.startswith("serving.")}
+        out["serving.ttft_p50_ms"] = _r(_pct(self.ttft_s, 50))
+        out["serving.ttft_p99_ms"] = _r(_pct(self.ttft_s, 99))
+        out["serving.tpot_mean_ms"] = _r(
+            float(np.mean(self.tpot_s)) if self.tpot_s else None)
+        return out
+
+    @staticmethod
+    def reset_monitor():
+        """Zero every serving.* monitor counter (tests, engine swap)."""
+        for k in list(monitor.get_all()):
+            if k.startswith("serving."):
+                monitor.reset(k)
+
+
+def _r(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 3)

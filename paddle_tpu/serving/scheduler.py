@@ -1,0 +1,402 @@
+"""Continuous-batching scheduler over an `EngineCore`.
+
+The serving analog of vLLM-style continuous batching, with the TPU shape
+discipline from Ragged Paged Attention (PAPERS.md): the decode step is ONE
+fixed-shape program over `max_batch_size` slots — requests churn through
+the slots (admit / finish mid-batch / preempt), the program never changes
+shape, so the steady state performs ZERO recompiles.
+
+Policy (documented in docs/SERVING.md):
+- admission: FIFO from the waiting queue into free slots; a request is
+  admitted when its (bucket-padded) prompt allocation succeeds. Pool
+  exhaustion (`KVCacheExhausted`) leaves it queued — never crashes.
+- prefill: per-request, prompt right-padded to a power-of-two bucket so
+  prefill compiles O(log max_seq) programs; surplus padding blocks are
+  returned via `BlockCacheManager.trim` right after.
+- preemption: when a RUNNING sequence cannot grow (pool exhausted on a
+  block boundary), the most-recently-admitted other sequence is evicted
+  back to the FRONT of the queue (LIFO victim, FIFO service order); its
+  tokens so far are kept and re-prefilled on re-admission.
+- eviction: finished/cancelled/expired sequences free their blocks
+  immediately; the slot admits a new request on the same step.
+- padding: empty slots decode with ctx_len=1 against a dedicated guard
+  block (never a sequence's block), so padded lanes can't corrupt live KV.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+import numpy as np
+
+from ..inference.cache import KVCacheExhausted, SequenceTooLong
+from .engine import EngineCore
+from .metrics import ServingMetrics
+
+__all__ = ["SamplingParams", "RequestStatus", "Request", "Scheduler"]
+
+_PAD_SEQ_ID = -1
+
+
+class SamplingParams:
+    """Per-request decoding knobs (greedy by default)."""
+
+    def __init__(self, max_new_tokens: int = 16, temperature: float = 0.0,
+                 top_k: int = 0, eos_token_id: Optional[int] = None,
+                 seed: int = 0):
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_token_id = eos_token_id
+        self.seed = seed
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTED = "preempted"     # back in queue, tokens-so-far kept
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    TIMED_OUT = "timed_out"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (RequestStatus.FINISHED, RequestStatus.CANCELLED,
+                        RequestStatus.REJECTED, RequestStatus.TIMED_OUT)
+
+
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+                 deadline: Optional[float] = None,
+                 stream_cb: Optional[Callable[["Request", int], None]] = None):
+        self.req_id = next(Request._ids)
+        self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.sampling = sampling or SamplingParams()
+        self.deadline = deadline              # absolute perf_counter time
+        self.stream_cb = stream_cb
+        self.generated: List[int] = []
+        self.status = RequestStatus.QUEUED
+        self.finish_reason: Optional[str] = None
+        self.num_preemptions = 0
+        self.t_submit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self._last: Optional[int] = None      # sampled, KV not yet written
+        self._admit_seq = -1                  # admission order (victim pick)
+        self._rng = np.random.default_rng(self.sampling.seed + self.req_id)
+
+    @property
+    def seq_id(self) -> int:
+        return self.req_id
+
+    def context_tokens(self) -> np.ndarray:
+        """Tokens whose KV must be in-cache before the next decode: the
+        prompt plus all generated tokens EXCEPT the pending last one (the
+        decode step itself writes the pending token's KV)."""
+        gen = self.generated[:-1] if self._last is not None else self.generated
+        return np.concatenate([self.prompt,
+                               np.asarray(gen, np.int32)]).astype(np.int32)
+
+    def ttft(self) -> Optional[float]:
+        if self.t_first_token is None or self.t_submit is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    def tpot(self) -> Optional[float]:
+        """Mean time-per-output-token after the first."""
+        if (self.t_finish is None or self.t_first_token is None
+                or len(self.generated) < 2):
+            return None
+        return (self.t_finish - self.t_first_token) / (len(self.generated) - 1)
+
+
+class Scheduler:
+    """Admits requests into decode slots and drives fixed-shape steps."""
+
+    def __init__(self, engine: EngineCore,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue: int = 256):
+        self.engine = engine
+        self.metrics = metrics or ServingMetrics()
+        self.max_queue = max_queue
+        self.slots: List[Optional[Request]] = [None] * engine.max_batch_size
+        self.waiting: Deque[Request] = deque()
+        self._admit_counter = itertools.count()
+        mgr = engine.manager
+        # Guard block for padded decode lanes: empty slots point their block
+        # table at this block (ctx_len=1), so the decode write for a padded
+        # lane lands here, never in a live sequence's block. Negative ids
+        # keep it out of the request id space; probe downward in case
+        # another scheduler already leases -1 on a shared engine.
+        pad_id = _PAD_SEQ_ID
+        while True:
+            try:
+                self._pad_block = mgr.allocate(pad_id, 1)[0]
+                break
+            except ValueError:
+                pad_id -= 1
+        # What one sequence can ever hold: pool minus the guard (and minus
+        # blocks other users of a shared engine already lease).
+        self._usable_blocks = min(mgr.free_blocks, mgr.max_blocks_per_seq)
+        self._buckets = [mgr.block_size]
+        max_tokens = mgr.max_blocks_per_seq * mgr.block_size
+        while self._buckets[-1] < max_tokens:
+            self._buckets.append(min(self._buckets[-1] * 2, max_tokens))
+
+    # ---- submission / cancellation ----
+    def submit(self, req: Request, now: Optional[float] = None) -> Request:
+        """Admission control. Rejects (with `finish_reason`) instead of
+        raising: over-long prompts and a full queue are load conditions,
+        not bugs."""
+        now = time.perf_counter() if now is None else now
+        req.t_submit = now
+        self.metrics.on_submit()
+        mgr = self.engine.manager
+        if len(req.prompt) == 0:
+            return self._reject(req, "empty_prompt")
+        # +1: the sequence must be able to hold at least one generated token
+        if mgr.blocks_needed(len(req.prompt) + 1) > self._usable_blocks:
+            return self._reject(req, "prompt_too_long")
+        if len(self.waiting) >= self.max_queue:
+            return self._reject(req, "queue_full")
+        self.waiting.append(req)
+        self.metrics.gauge_queue(len(self.waiting))
+        return req
+
+    def _reject(self, req: Request, reason: str) -> Request:
+        req.status = RequestStatus.REJECTED
+        req.finish_reason = reason
+        req.t_finish = time.perf_counter()
+        self.metrics.on_reject(reason)
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        if req.status.terminal:
+            return False
+        if req in self.waiting:
+            self.waiting.remove(req)
+            self.metrics.gauge_queue(len(self.waiting))
+            self._finish(req, RequestStatus.CANCELLED, "cancelled",
+                         in_slot=False)
+            return True
+        for i, r in enumerate(self.slots):
+            if r is req:
+                self._finish(req, RequestStatus.CANCELLED, "cancelled",
+                             slot=i)
+                return True
+        return False
+
+    # ---- the step ----
+    def step(self, now: Optional[float] = None) -> int:
+        """One scheduling round: expire deadlines, admit into free slots,
+        run one fixed-shape decode over the occupied slots. Returns the
+        number of tokens produced this step."""
+        now = time.perf_counter() if now is None else now
+        self._expire(now)
+        self._admit(now)
+        produced = self._decode(now)
+        mgr = self.engine.manager
+        # occupancy = decoded lanes / total lanes for THIS step (finished
+        # sequences were already evicted, so num_running undercounts)
+        self.metrics.on_step(
+            occupancy=produced / len(self.slots),
+            kv_utilization=mgr.utilization(),
+            queue_depth=len(self.waiting),
+            decoded=produced > 0)
+        return produced
+
+    @property
+    def num_running(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    @property
+    def idle(self) -> bool:
+        return self.num_running == 0 and not self.waiting
+
+    # ---- phases ----
+    def _expire(self, now: float):
+        for req in [r for r in self.waiting
+                    if r.deadline is not None and now > r.deadline]:
+            self.waiting.remove(req)
+            self._finish(req, RequestStatus.TIMED_OUT, "deadline_in_queue",
+                         in_slot=False)
+        self.metrics.gauge_queue(len(self.waiting))
+        for i, req in enumerate(self.slots):
+            if req is not None and req.deadline is not None \
+                    and now > req.deadline:
+                self._finish(req, RequestStatus.TIMED_OUT,
+                             "deadline_while_running", slot=i)
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _admit(self, now: float):
+        mgr = self.engine.manager
+        while self.waiting and None in self.slots:
+            req = self.waiting[0]
+            ctx = req.context_tokens()
+            bucket = self._bucket(len(ctx))
+            try:
+                mgr.allocate(req.seq_id, bucket)
+            except (KVCacheExhausted, SequenceTooLong) as e:
+                # Bucket padding overshot (the per-seq cap, or a pool with
+                # no runners left to free blocks): retry unpadded. A plain
+                # pool wait (runners will free blocks) stays queued.
+                if isinstance(e, KVCacheExhausted) and self.num_running > 0:
+                    break
+                try:
+                    mgr.allocate(req.seq_id, len(ctx))
+                    bucket = len(ctx)
+                except (KVCacheExhausted, SequenceTooLong):
+                    break
+            self.waiting.popleft()
+            slot = self.slots.index(None)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(ctx)] = ctx
+            tables = mgr.block_table_array([req.seq_id])
+            from ..profiler import RecordEvent
+
+            with RecordEvent("serving.prefill"):
+                logits = self.engine.prefill(
+                    padded, tables, lens=np.asarray([len(ctx)], np.int32))
+            mgr.trim(req.seq_id, len(ctx))
+            self.metrics.on_prefill(len(ctx))
+            was_preempted = req.status is RequestStatus.PREEMPTED
+            req.status = RequestStatus.RUNNING
+            req._admit_seq = next(self._admit_counter)
+            self.slots[slot] = req
+            if not was_preempted:
+                tok = self._sample(np.asarray(logits)[0], req)
+                req.generated.append(tok)
+                req._last = tok
+                if req.t_first_token is None:
+                    req.t_first_token = time.perf_counter()
+                    self.metrics.on_first_token(req)
+                if req.stream_cb is not None:
+                    req.stream_cb(req, tok)
+                self._maybe_finish_on_token(req, tok, slot)
+            # preempted re-admissions keep their pending `_last`; the
+            # prefill logits above are for a token already sampled — drop.
+        self.metrics.gauge_queue(len(self.waiting))
+
+    def _grow(self, req: Request, slot: int) -> bool:
+        """Account the pending token's cache slot; preempt on exhaustion.
+        Returns False if the request left the batch instead."""
+        mgr = self.engine.manager
+        while True:
+            try:
+                mgr.append_token(req.seq_id)
+                return True
+            except SequenceTooLong:
+                self._finish(req, RequestStatus.FINISHED, "length_cap",
+                             slot=slot)
+                return False
+            except KVCacheExhausted:
+                if not self._preempt_one(exclude=req):
+                    # nothing left to steal from: the pool is truly full
+                    self._finish(req, RequestStatus.FINISHED, "kv_capacity",
+                                 slot=slot)
+                    return False
+
+    def _preempt_one(self, exclude: Request) -> bool:
+        """Evict the most-recently-admitted running request (≠ exclude)
+        back to the FRONT of the queue, keeping its tokens so far."""
+        victims = [(r._admit_seq, i) for i, r in enumerate(self.slots)
+                   if r is not None and r is not exclude]
+        if not victims:
+            return False
+        _, slot = max(victims)
+        req = self.slots[slot]
+        self.engine.manager.free(req.seq_id)
+        self.slots[slot] = None
+        req.status = RequestStatus.PREEMPTED
+        req.num_preemptions += 1
+        self.waiting.appendleft(req)
+        self.metrics.on_preempt()
+        self.metrics.gauge_queue(len(self.waiting))
+        return True
+
+    def _decode(self, now: float) -> int:
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return 0
+        # grow (and possibly preempt) before building the batch arrays
+        grown = []
+        for i, req in active:
+            if self.slots[i] is req and self._grow(req, i):
+                grown.append((i, req))
+        active = [(i, r) for i, r in grown if self.slots[i] is r]
+        if not active:
+            return 0
+        mgr = self.engine.manager
+        B = len(self.slots)
+        tokens = np.zeros((B,), np.int32)
+        lens = np.ones((B,), np.int32)
+        tables = np.full((B, mgr.max_blocks_per_seq), self._pad_block,
+                         np.int32)
+        for i, req in active:
+            tokens[i] = req._last
+            lens[i] = mgr.seq_len(req.seq_id)
+            tables[i] = mgr.block_table_array([req.seq_id])[0]
+        from ..profiler import RecordEvent
+
+        with RecordEvent("serving.decode_step"):
+            logits = np.asarray(self.engine.decode_step(tokens, lens, tables))
+        t_tok = time.perf_counter()
+        produced = 0
+        for i, req in active:
+            tok = self._sample(logits[i], req)
+            req.generated.append(tok)
+            req._last = tok
+            produced += 1
+            if req.t_first_token is None:
+                req.t_first_token = t_tok
+                self.metrics.on_first_token(req)
+            if req.stream_cb is not None:
+                req.stream_cb(req, tok)
+            self._maybe_finish_on_token(req, tok, i)
+        self.metrics.on_decode(produced)
+        return produced
+
+    def _maybe_finish_on_token(self, req: Request, tok: int, slot: int):
+        sp = req.sampling
+        if sp.eos_token_id is not None and tok == sp.eos_token_id:
+            self._finish(req, RequestStatus.FINISHED, "eos", slot=slot)
+        elif len(req.generated) >= sp.max_new_tokens:
+            self._finish(req, RequestStatus.FINISHED, "max_new_tokens",
+                         slot=slot)
+
+    def _finish(self, req: Request, status: RequestStatus, reason: str,
+                slot: Optional[int] = None, in_slot: bool = True):
+        if in_slot:
+            if slot is None:
+                slot = self.slots.index(req)
+            self.slots[slot] = None
+            self.engine.manager.free(req.seq_id)
+        req.status = status
+        req.finish_reason = reason
+        req.t_finish = time.perf_counter()
+        self.metrics.on_finish(req)
+
+    def _sample(self, logits: np.ndarray, req: Request) -> int:
+        sp = req.sampling
+        if sp.temperature <= 0.0:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / max(sp.temperature, 1e-6)
+        if sp.top_k:
+            kth = np.partition(x, -sp.top_k)[-sp.top_k]
+            x = np.where(x < kth, -np.inf, x)
+        p = np.exp(x - x.max())
+        p /= p.sum()
+        return int(req._rng.choice(len(p), p=p))
