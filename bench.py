@@ -42,15 +42,38 @@ _PROBE_SRC = (
 )
 
 
+def _load_retry_standalone():
+    """Load `paddle_tpu/framework/retry.py` WITHOUT importing the package:
+    the probe's whole point is that the parent process stays jax-free so
+    the subprocess can own the exclusive TPU chip. retry.py is stdlib-only
+    by contract for exactly this caller."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "paddle_tpu", "framework", "retry.py")
+    spec = importlib.util.spec_from_file_location("_pt_retry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _ProbeFailed(Exception):
+    pass
+
+
 def _probe_tpu(timeouts=(180.0, 300.0, 300.0)):
     """Probe the TPU backend from a throwaway subprocess; return a
     diagnostics dict that goes verbatim into the bench JSON.
 
     Round-4/5 hardening: the probe window is raised beyond the old 2x120 s
-    (slow TPU runtime bring-up was read as 'no TPU'), with one extra retry
-    and backoff between attempts."""
+    (slow TPU runtime bring-up was read as 'no TPU'); the retry/backoff
+    schedule now comes from the shared `framework/retry.py` policy instead
+    of a hand-rolled loop."""
+    retry = _load_retry_standalone()
     diag = {"ok": False, "attempts": []}
-    for attempt, timeout in enumerate(timeouts):
+
+    def attempt_once():
+        timeout = timeouts[min(len(diag["attempts"]), len(timeouts) - 1)]
         t0 = time.time()
         try:
             r = subprocess.run(
@@ -68,11 +91,17 @@ def _probe_tpu(timeouts=(180.0, 300.0, 300.0)):
                    "secs": round(time.time() - t0, 1),
                    "timeout": True}
         diag["attempts"].append(rec)
-        if rec.get("rc") == 0 and "cpu" not in rec["out"].split("|")[0]:
-            diag["ok"] = True
-            return diag
-        if attempt + 1 < len(timeouts):
-            time.sleep(5 * (attempt + 1))  # backoff before the retry
+        if not (rec.get("rc") == 0
+                and "cpu" not in rec["out"].split("|")[0]):
+            raise _ProbeFailed(rec.get("err_tail", ""))
+
+    try:
+        retry.retry_call(attempt_once, retries=len(timeouts) - 1,
+                         base_delay=5.0, max_delay=10.0, jitter=0.0,
+                         retry_on=(_ProbeFailed,), monitor_name=None)
+    except _ProbeFailed:
+        return diag
+    diag["ok"] = True
     return diag
 
 

@@ -69,8 +69,8 @@ import importlib.util as _ilu  # noqa: E402
 for _mod in ("nn", "optimizer", "amp", "io", "jit", "static", "metric", "vision",
              "distributed", "autograd", "hapi", "incubate", "profiler",
              "distribution", "fft", "sparse", "quantization", "onnx", "utils",
-             "device", "inference", "serving", "signal", "audio", "text",
-             "geometric", "hub", "sysconfig"):
+             "device", "inference", "serving", "resilience", "signal",
+             "audio", "text", "geometric", "hub", "sysconfig"):
     if _ilu.find_spec(f"{__name__}.{_mod}") is not None:
         __import__(f"{__name__}.{_mod}")
 

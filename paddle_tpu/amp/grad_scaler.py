@@ -38,6 +38,8 @@ class AmpScaler:
         self._incr_count = 0
         self._decr_count = 0
         self._found_inf = False
+        self._skipped_steps = 0      # total found-inf skips over the run
+        self._last_skipped = False   # did the most recent step() skip?
         self._opt_states: Dict[int, OptimizerState] = {}
         self._unscale_fn = None
 
@@ -122,6 +124,23 @@ class AmpScaler:
                 self._loss_scaling *= self._incr_ratio
                 self._incr_count = 0
 
+    def _note_skip(self):
+        """Record whether the step just decided was a found-inf skip (the
+        signal `resilience.StepGuard` composes with: a skip is normal AMP
+        behaviour, a long streak of them is a tripped run)."""
+        self._last_skipped = bool(self._found_inf)
+        if self._found_inf:
+            self._skipped_steps += 1
+            from ..framework import monitor
+
+            monitor.inc("amp.skipped_steps")
+
+    def last_step_skipped(self) -> bool:
+        return self._last_skipped
+
+    def get_skipped_steps(self) -> int:
+        return self._skipped_steps
+
     def minimize(self, optimizer, *args, **kwargs):
         if not self._enable:
             return optimizer.minimize(*args, **kwargs)
@@ -129,6 +148,7 @@ class AmpScaler:
             self._unscale(optimizer)
         if not self._found_inf:
             optimizer.step()
+        self._note_skip()
         self._update()
         self._opt_states.pop(id(optimizer), None)
         optimizer.clear_grad()
@@ -211,6 +231,7 @@ class GradScaler(AmpScaler):
             self._unscale(optimizer)
         if not self._found_inf:
             optimizer.step()
+        self._note_skip()
         self._opt_states[id(optimizer)] = OptimizerState.STEPPED
 
     def update(self):

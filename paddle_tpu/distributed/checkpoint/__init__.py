@@ -4,9 +4,11 @@ Analog of `python/paddle/distributed/checkpoint/`: per-shard save with a
 global metadata index, replicated-shard dedup, async save, and
 reshard-on-load to a different mesh/placement.
 """
-from .load_state_dict import load_state_dict
+from .errors import AsyncSaveError, CheckpointCorrupt
+from .load_state_dict import load_state_dict, verify_checkpoint
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .save_state_dict import save_state_dict
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata",
-           "LocalTensorMetadata", "LocalTensorIndex"]
+__all__ = ["save_state_dict", "load_state_dict", "verify_checkpoint",
+           "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
+           "CheckpointCorrupt", "AsyncSaveError"]

@@ -19,33 +19,70 @@ import numpy as np
 
 from ...core.tensor import Tensor
 from ...framework import safetensors as sft
+from .errors import CheckpointCorrupt
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .save_state_dict import _wait_pending, shard_name
 
-__all__ = ["load_state_dict"]
+__all__ = ["load_state_dict", "verify_checkpoint"]
 
 
 class _StorageReader:
     """Lazy per-shard reads from the safetensors .distcp files: only the
     header is parsed up front; each tensor read seeks its offsets and
-    verifies its crc32 (`framework/safetensors.py`)."""
+    verifies its crc32 (`framework/safetensors.py`). Every failure mode —
+    missing file, short file, unparseable header, missing shard entry,
+    crc mismatch — surfaces as a typed :class:`CheckpointCorrupt` naming
+    the tensor key and shard file."""
 
     def __init__(self, path: str):
         self.path = path
         self._readers: Dict[str, sft.SafetensorsReader] = {}
 
-    def blob(self, fname: str, key, offset):
+    def _reader(self, fname: str, key: str = "") -> sft.SafetensorsReader:
         r = self._readers.get(fname)
         if r is None:
-            r = self._readers[fname] = sft.SafetensorsReader(
-                os.path.join(self.path, fname))
-        return r.get_tensor(shard_name(key, offset))
+            try:
+                r = sft.SafetensorsReader(os.path.join(self.path, fname))
+            except FileNotFoundError:
+                raise CheckpointCorrupt(
+                    self.path, "shard file referenced by 0.metadata is "
+                    "missing", key=key, file=fname)
+            except (ValueError, KeyError, json.JSONDecodeError,
+                    EOFError, OSError) as exc:
+                raise CheckpointCorrupt(
+                    self.path, f"unreadable shard header: {exc!r}",
+                    key=key, file=fname)
+            self._readers[fname] = r
+        return r
+
+    def blob(self, fname: str, key, offset):
+        r = self._reader(fname, key=key)
+        name = shard_name(key, offset)
+        if name not in r.header:
+            raise CheckpointCorrupt(
+                self.path, "shard entry missing from file header",
+                key=key, file=fname)
+        try:
+            return r.get_tensor(name)  # crc32-verified read
+        except (IOError, ValueError, KeyError) as exc:
+            # KeyError: corrupted header entry (e.g. unknown dtype tag) —
+            # the header JSON parses but its content is garbage
+            raise CheckpointCorrupt(
+                self.path, f"shard read failed integrity check: {exc!r}",
+                key=key, file=fname)
 
 
 def _read_metadata(path: str) -> Metadata:
     """Parse the JSON `0.metadata` index into the Metadata dataclasses."""
-    with open(os.path.join(path, "0.metadata")) as f:
-        raw = json.load(f)
+    try:
+        with open(os.path.join(path, "0.metadata")) as f:
+            raw = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorrupt(path, "no 0.metadata index (incomplete or "
+                                "torn save)", file="0.metadata")
+    except json.JSONDecodeError as exc:
+        raise CheckpointCorrupt(path, f"unparseable 0.metadata: {exc}",
+                                file="0.metadata")
     meta = Metadata(state_dict_metadata={}, storage_metadata={},
                     flat_mapping=None)
     for key, metas in raw["state_dict_metadata"].items():
@@ -83,8 +120,56 @@ def _assemble(dest_index, global_shape, saved_metas, storage, reader, key,
         src_sl = tuple(slice(a - o, b - o)
                        for a, b, o in zip(ilo, ihi, s_lo))
         dst_sl = tuple(slice(a - o, b - o) for a, b, o in zip(ilo, ihi, lo))
-        out[dst_sl] = src[src_sl]
+        piece = src[src_sl]
+        # rank-normalise (pre-fix checkpoints stored 0-d shards as (1,))
+        out[dst_sl] = np.asarray(piece).reshape(np.shape(out[dst_sl]))
     return out
+
+
+def verify_checkpoint(path: str, meta: Metadata = None,
+                      reader: "_StorageReader" = None) -> Metadata:
+    """Structural integrity check of a checkpoint directory: the
+    `0.metadata` index parses, and every shard file it references exists
+    and is long enough to hold every shard assigned to it (header entry
+    present, data offsets within the file). Raises
+    :class:`CheckpointCorrupt` naming the first bad key/file; returns the
+    parsed metadata. Byte-level crc32 verification additionally happens on
+    every shard actually read."""
+    if meta is None:
+        meta = _read_metadata(path)
+    # every shard the tensor index declares must have a storage entry, or
+    # _assemble would later leak a raw KeyError instead of the typed error
+    # fallback policies are written against
+    for key, metas in meta.state_dict_metadata.items():
+        for m in metas:
+            ix = LocalTensorIndex(key, tuple(m.global_offset))
+            if ix not in meta.storage_metadata:
+                raise CheckpointCorrupt(
+                    path, "no shard file recorded for tensor shard "
+                    f"(offset {tuple(m.global_offset)})", key=key,
+                    file="0.metadata")
+    if reader is None:
+        reader = _StorageReader(path)
+    by_file: Dict[str, list] = {}
+    for ix, fname in meta.storage_metadata.items():
+        by_file.setdefault(fname, []).append(ix)
+    for fname, indices in sorted(by_file.items()):
+        key0 = indices[0].tensor_key
+        r = reader._reader(fname, key=key0)
+        size = os.path.getsize(os.path.join(path, fname))
+        for ix in indices:
+            name = shard_name(ix.tensor_key, ix.global_offset)
+            ent = r.header.get(name)
+            if ent is None:
+                raise CheckpointCorrupt(
+                    path, "shard entry missing from file header",
+                    key=ix.tensor_key, file=fname)
+            if r._data_start + ent["data_offsets"][1] > size:
+                raise CheckpointCorrupt(
+                    path, f"shard file truncated ({size} bytes, tensor "
+                    f"needs {r._data_start + ent['data_offsets'][1]})",
+                    key=ix.tensor_key, file=fname)
+    return meta
 
 
 def load_state_dict(state_dict: Dict[str, Tensor], path: str,
@@ -94,9 +179,11 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
     the destination's current sharding."""
     import jax
 
-    _wait_pending()  # async saves must be on disk before we read
-    meta = _read_metadata(path)
+    _wait_pending(path)  # a pending async save to this path must land first
     reader = _StorageReader(path)
+    # typed CheckpointCorrupt on torn dirs; shares the reader so each shard
+    # header is opened and parsed once, not twice
+    meta = verify_checkpoint(path, reader=reader)
 
     for key, t in state_dict.items():
         if key not in meta.state_dict_metadata:

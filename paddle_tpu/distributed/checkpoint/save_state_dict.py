@@ -26,9 +26,10 @@ import numpy as np
 
 from ...core.tensor import Tensor
 from ...framework import safetensors as sft
+from .errors import AsyncSaveError
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
-__all__ = ["save_state_dict"]
+__all__ = ["save_state_dict", "snapshot_state_dict", "write_snapshot"]
 
 FORMAT_TAG = "paddle_tpu.distcp.v2+safetensors"
 
@@ -37,13 +38,50 @@ def shard_name(key: str, offset) -> str:
     """Flat tensor name inside a shard file: `<key>@@<o0>_<o1>...`."""
     return f"{key}@@{'_'.join(str(int(o)) for o in offset)}"
 
-_pending_saves = []
+# One pending background write per destination path. Guarded by
+# `_pending_lock`: the old bare list was popped by `_wait_pending` while
+# `save_state_dict` appended concurrently, and a failed thread's exception
+# vanished with the thread.
+_pending_lock = threading.Lock()
+_pending_saves: Dict[str, threading.Thread] = {}
 
 
-def _wait_pending():
-    while _pending_saves:
-        t = _pending_saves.pop()
+class _SaveThread(threading.Thread):
+    """Background writer that captures its exception instead of printing a
+    traceback to stderr and dying silently."""
+
+    def __init__(self, write):
+        super().__init__(daemon=False)
+        self._write = write
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        try:
+            self._write()
+        except BaseException as exc:  # surfaced by _wait_pending
+            self.error = exc
+
+
+def _wait_pending(path: Optional[str] = None):
+    """Join pending async saves (all of them, or just ``path``'s) and
+    re-raise the first captured background failure as
+    :class:`AsyncSaveError` on this thread."""
+    with _pending_lock:
+        if path is None:
+            items = list(_pending_saves.items())
+            _pending_saves.clear()
+        else:
+            key = os.path.abspath(path)
+            t = _pending_saves.pop(key, None)
+            items = [(key, t)] if t is not None else []
+    error = None
+    for key, t in items:
         t.join()
+        exc = getattr(t, "error", None)
+        if exc is not None and error is None:
+            error = AsyncSaveError(key, exc)
+    if error is not None:
+        raise error
 
 
 def _shards_of(arr):
@@ -56,14 +94,25 @@ def _shards_of(arr):
     return out
 
 
-def save_state_dict(state_dict: Dict[str, Tensor], path: str,
-                    process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False) -> None:
-    """Save a (possibly sharded) state_dict to ``path`` as per-device
-    ``{device}_0.distcp`` shard files plus a global ``0.metadata`` index."""
-    import jax
+class _Snapshot:
+    """Host-memory image of a state_dict: the parsed shard metadata plus
+    every (deduped) shard as a numpy array. Building one is the ONLY step
+    that touches device buffers; writing it is pure file I/O and may run
+    on a background thread or be retried arbitrarily."""
 
-    os.makedirs(path, exist_ok=True)
+    def __init__(self, meta: Metadata, per_device: Dict[int, dict]):
+        self.meta = meta
+        self.per_device = per_device
+
+
+def snapshot_state_dict(state_dict: Dict[str, Tensor]) -> _Snapshot:
+    """Device->host snapshot of ``state_dict`` on the CALLER's thread.
+
+    This must not be deferred to a writer thread: the optimizer's fused
+    step donates the previous param/moment buffers (`jax.jit(...,
+    donate_argnums=...)`), so a reference held across the next
+    `optimizer.step()` is a deleted array, not a snapshot. The numpy
+    copies made here are immune to that donation."""
     meta = Metadata(state_dict_metadata={}, storage_metadata={},
                     flat_mapping=None)
     per_device: Dict[int, dict] = {}
@@ -80,7 +129,12 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
             if index in seen:  # replicated shard: save one copy only
                 continue
             seen.add(index)
-            host = np.asarray(data)  # device->host snapshot (async-safe)
+            host = np.asarray(data)  # device->host snapshot
+            if host.ndim != len(global_shape) and host.size == 1:
+                # 0-d arrays: PJRT hands the shard back as shape (1,);
+                # keep the stored rank equal to the tensor's real rank so
+                # reshard-on-load never mixes ranks
+                host = host.reshape(global_shape)
             fname = f"{dev_id}_0.distcp"
             per_device.setdefault(dev_id, {})[(key, offset)] = host
             meta.storage_metadata[index] = fname
@@ -88,37 +142,83 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
                 offset, tuple(host.shape), str(host.dtype), global_shape))
         if metas:
             meta.state_dict_metadata[key] = metas
+    return _Snapshot(meta, per_device)
+
+
+def write_snapshot(snap: _Snapshot, path: str,
+                   coordinator_rank: int = 0) -> None:
+    """Write a host snapshot to ``path``: per-device shard files, then the
+    coordinator's global ``0.metadata`` index last (its presence marks a
+    complete checkpoint). Touches no device buffers — safe on any thread,
+    safe to retry."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    for dev_id, blobs in snap.per_device.items():
+        tensors = {shard_name(k, off): host
+                   for (k, off), host in blobs.items()}
+        sft.save_file(tensors, os.path.join(path, f"{dev_id}_0.distcp"),
+                      metadata={"format": FORMAT_TAG})
+    if jax.process_index() == coordinator_rank:
+        index = {
+            "format": FORMAT_TAG,
+            "state_dict_metadata": {
+                k: [{"global_offset": list(m.global_offset),
+                     "local_shape": list(m.local_shape),
+                     "dtype": m.dtype,
+                     "global_shape": list(m.global_shape)}
+                    for m in metas]
+                for k, metas in snap.meta.state_dict_metadata.items()},
+            "storage_metadata": {
+                shard_name(ix.tensor_key, ix.global_offset): fname
+                for ix, fname in snap.meta.storage_metadata.items()},
+        }
+        tmp = os.path.join(path, "0.metadata.tmp")
+        with open(tmp, "w") as f:
+            json.dump(index, f)
+        os.replace(tmp, os.path.join(path, "0.metadata"))
+
+
+def save_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    async_save: bool = False) -> None:
+    """Save a (possibly sharded) state_dict to ``path`` as per-device
+    ``{device}_0.distcp`` shard files plus a global ``0.metadata`` index."""
+    os.makedirs(path, exist_ok=True)
+    snap = snapshot_state_dict(state_dict)
 
     def write():
-        for dev_id, blobs in per_device.items():
-            tensors = {shard_name(k, off): host
-                       for (k, off), host in blobs.items()}
-            sft.save_file(tensors, os.path.join(path, f"{dev_id}_0.distcp"),
-                          metadata={"format": FORMAT_TAG})
-        # the coordinator writes the global index last (its presence marks a
-        # complete checkpoint)
-        if jax.process_index() == coordinator_rank:
-            index = {
-                "format": FORMAT_TAG,
-                "state_dict_metadata": {
-                    k: [{"global_offset": list(m.global_offset),
-                         "local_shape": list(m.local_shape),
-                         "dtype": m.dtype,
-                         "global_shape": list(m.global_shape)}
-                        for m in metas]
-                    for k, metas in meta.state_dict_metadata.items()},
-                "storage_metadata": {
-                    shard_name(ix.tensor_key, ix.global_offset): fname
-                    for ix, fname in meta.storage_metadata.items()},
-            }
-            tmp = os.path.join(path, "0.metadata.tmp")
-            with open(tmp, "w") as f:
-                json.dump(index, f)
-            os.replace(tmp, os.path.join(path, "0.metadata"))
+        write_snapshot(snap, path, coordinator_rank)
 
-    if async_save:
-        th = threading.Thread(target=write, daemon=False)
-        th.start()
-        _pending_saves.append(th)
-    else:
-        write()
+    # A second save to the same path (sync or async) must not interleave
+    # with a pending write — shard files would mix two checkpoints. EVERY
+    # save (sync ones too) claims the per-path slot before writing; the
+    # drain-and-register is one atomic claim, or two concurrent callers
+    # could both pass the drain and write together. A pending writer's
+    # captured failure re-raises here (AsyncSaveError) before the new
+    # write starts.
+    key = os.path.abspath(path)
+    th = _SaveThread(write)
+    while True:
+        with _pending_lock:
+            prev = _pending_saves.get(key)
+            if prev is None:
+                _pending_saves[key] = th
+                # started inside the lock: a concurrent _wait_pending that
+                # pops this entry the instant the lock drops must never
+                # join an unstarted thread (RuntimeError)
+                th.start()
+                break
+        prev.join()
+        with _pending_lock:
+            if _pending_saves.get(key) is prev:
+                _pending_saves.pop(key)
+        if prev.error is not None:
+            raise AsyncSaveError(key, prev.error)
+    if not async_save:
+        th.join()
+        with _pending_lock:
+            if _pending_saves.get(key) is th:
+                _pending_saves.pop(key)
+        if th.error is not None:
+            raise th.error  # sync callers get the original exception

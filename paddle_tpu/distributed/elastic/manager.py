@@ -45,6 +45,20 @@ class ElasticManager:
         this pod's process die."""
         self.store.deregister(pod_id)
 
+    def reap_stale(self, timeout_s: Optional[float] = None,
+                   now: Optional[float] = None) -> List[str]:
+        """Heartbeat-timeout sweep: deregister pods that stopped
+        heartbeating without an explicit `report_dead` (host gone, network
+        partition). Returns the reaped pod ids and bumps the
+        ``elastic.reaped`` counter. Defaults to the store's TTL."""
+        from ...framework import monitor
+
+        reaped = self.store.reap_stale(
+            self.store.ttl if timeout_s is None else timeout_s, now=now)
+        if reaped:
+            monitor.inc("elastic.reaped", len(reaped))
+        return reaped
+
     def ranks(self) -> List[str]:
         """Dense rank order over live pods (reference rank regeneration:
         sorted pod ids -> 0..n-1), capped at max_nodes."""

@@ -13,22 +13,37 @@ import fcntl
 import json
 import os
 import time
-from typing import Dict
+from typing import Dict, List, Optional
+
+from ...framework.retry import retry_call
 
 __all__ = ["MembershipStore"]
 
 
 class MembershipStore:
-    def __init__(self, path: str, ttl: float = 10.0):
+    def __init__(self, path: str, ttl: float = 10.0,
+                 lock_timeout: float = 30.0):
         self.path = path
         self.ttl = float(ttl)
+        self.lock_timeout = float(lock_timeout)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
 
     def _locked(self, mutate):
-        """Run `mutate(pods_dict) -> result` under an exclusive file lock."""
+        """Run `mutate(pods_dict) -> result` under an exclusive file lock.
+
+        The lock is taken non-blocking through `framework.retry` (backoff
+        + deadline + `elastic.lock_retries` counter) instead of the old
+        unbounded blocking flock: a launcher wedged holding the lock now
+        surfaces as a timeout on its peers, not a silent hang."""
         lock_path = self.path + ".lock"
         with open(lock_path, "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
+            # only EWOULDBLOCK (lock held) is transient; ENOLCK and friends
+            # are permanent and must fail fast, not spin for lock_timeout
+            retry_call(fcntl.flock, lk, fcntl.LOCK_EX | fcntl.LOCK_NB,
+                       retries=10_000, base_delay=0.002, max_delay=0.05,
+                       deadline=self.lock_timeout,
+                       retry_on=(BlockingIOError,),
+                       monitor_name="elastic.lock_retries")
             try:
                 try:
                     with open(self.path) as f:
@@ -70,6 +85,25 @@ class MembershipStore:
 
     def deregister(self, pod_id: str) -> None:
         self._locked(lambda pods: pods.pop(pod_id, None))
+
+    def reap_stale(self, timeout_s: float,
+                   now: Optional[float] = None) -> List[str]:
+        """Deregister every pod whose last heartbeat is older than
+        ``timeout_s`` and return their ids (sorted). This is the sweep a
+        launcher runs when a pod stops heartbeating without ever calling
+        `deregister` — e.g. its host vanished. ``now`` is injectable so
+        tests sweep deterministically with zero sleeps."""
+        t = time.time() if now is None else float(now)
+
+        def mutate(pods):
+            stale = sorted(
+                k for k, v in pods.items()
+                if t - v.get("last_heartbeat", 0) > float(timeout_s))
+            for k in stale:
+                del pods[k]
+            return stale
+
+        return self._locked(mutate)
 
     def alive(self) -> Dict[str, dict]:
         """Live pods; entries past the TTL are expired (lease timeout)."""

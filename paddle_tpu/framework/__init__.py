@@ -1,4 +1,4 @@
-from . import dtype, flags, place, random
+from . import dtype, flags, place, random, retry
 from .dtype import (DType, bfloat16, bool_, complex64, complex128, convert_dtype,
                     float8_e4m3fn, float8_e5m2, float16, float32, float64,
                     get_default_dtype, int8, int16, int32, int64,

@@ -368,6 +368,7 @@ class Profiler:
                     f"{k}={v}" for k, v in counters.items()))
         lines.extend(self._lazy_summary_lines())
         lines.extend(self._serving_summary_lines())
+        lines.extend(self._resilience_summary_lines())
         return "\n".join(lines)
 
     @staticmethod
@@ -394,6 +395,38 @@ class Profiler:
             "Flush reasons: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(reasons.items())),
         ]
+
+    @staticmethod
+    def _resilience_summary_lines():
+        """Fault-tolerance stats (resilience/): checkpoint saves + their
+        transient-I/O retries, quarantined torn directories, StepGuard
+        rollbacks by trip reason, AMP skip streaks, emergency preemption
+        saves, and elastic heartbeat reaps."""
+        from ..framework import monitor
+
+        g = monitor.get
+        if not (g("resilience.saves") or g("resilience.rollbacks")
+                or g("resilience.quarantines")
+                or g("resilience.emergency_saves") or g("elastic.reaped")):
+            return []
+        trips = {k[len("resilience.trips."):]: v
+                 for k, v in monitor.get_all().items()
+                 if k.startswith("resilience.trips.") and v}
+        lines = [
+            "",
+            f"Resilience: {g('resilience.saves')} checkpoint saves "
+            f"({g('resilience.retries')} write retries, "
+            f"{g('resilience.emergency_saves')} emergency), "
+            f"{g('resilience.quarantines')} quarantined, "
+            f"{g('resilience.rollbacks')} rollbacks",
+            f"  amp skipped steps {g('amp.skipped_steps')}, "
+            f"elastic reaped {g('elastic.reaped')} "
+            f"(lock retries {g('elastic.lock_retries')})",
+        ]
+        if trips:
+            lines.append("  trip reasons: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(trips.items())))
+        return lines
 
     @staticmethod
     def _serving_summary_lines():

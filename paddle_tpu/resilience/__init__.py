@@ -1,0 +1,28 @@
+"""Fault-tolerant training runtime.
+
+The spine connecting the pieces that already existed — sharded safetensors
+checkpoints (`distributed/checkpoint/`), elastic membership
+(`distributed/elastic/`), AMP found-inf skipping (`amp/grad_scaler.py`) —
+into a testable survive-the-failure subsystem:
+
+- :class:`CheckpointManager` — rotating step-numbered checkpoint
+  directories with an atomic ``COMPLETE`` manifest, retention, verified
+  ``latest_valid()`` resume with quarantine of torn saves, async writes
+  whose errors re-raise on the caller, retry/backoff on transient I/O;
+- :class:`StepGuard` — NaN/spike detection around the train step,
+  rollback to the last verified checkpoint with a bounded restart
+  budget, and a SIGTERM emergency-checkpoint hook;
+- :mod:`faults` — the deterministic fault-injection registry that makes
+  every failure path above exercisable in tests
+  (``faults.inject("ckpt.write", after_n=3)``).
+
+See ``docs/RESILIENCE.md`` for the failure matrix and the checkpoint
+directory layout contract.
+"""
+from . import faults
+from .checkpoint_manager import CheckpointManager
+from .guard import (NoValidCheckpoint, Preempted, RestartBudgetExceeded,
+                    StepGuard)
+
+__all__ = ["CheckpointManager", "StepGuard", "RestartBudgetExceeded",
+           "NoValidCheckpoint", "Preempted", "faults"]

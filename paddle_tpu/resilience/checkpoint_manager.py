@@ -1,0 +1,430 @@
+"""Rotating, verified, fault-tolerant checkpoint manager.
+
+Reference analog: the fleet checkpoint layer that lets Paddle's elastic
+jobs survive preemption (`fleet/elastic/manager.py` fault tolerance +
+`paddle.distributed.checkpoint`). This wraps `distributed/checkpoint/`
+(sharded safetensors + crc32, reshard-on-load) with the *policy* a long
+training run needs:
+
+- **step-numbered directories** ``<root>/step_000123/`` holding the
+  sharded tensor files, an ``extra_state.json`` (step, RNG state,
+  optimizer scalars, GradScaler state, user extras), and a ``COMPLETE``
+  marker written atomically *last* — its manifest records every file's
+  size and crc32, so a directory without it (or whose bytes disagree
+  with it) is torn by definition;
+- **retention**: ``keep_last_n`` rolling checkpoints plus optional
+  ``keep_every_k`` milestone checkpoints kept forever;
+- **verified resume**: :meth:`latest_valid` walks step directories
+  newest-first, verifies each against its COMPLETE manifest, renames
+  failures to ``QUARANTINED-step_000123`` (kept for forensics, never
+  retried), and returns the newest checkpoint that checks out;
+- **async saves that cannot fail silently**: the background writer's
+  exception is captured and re-raised as ``AsyncSaveError`` at the next
+  :meth:`save`/:meth:`wait`; transient I/O failures inside one write are
+  retried with `framework.retry` (exponential backoff + deadline);
+- **monitor counters** (rendered by ``profiler.summary()``):
+  ``resilience.saves``, ``resilience.retries``, ``resilience.quarantines``,
+  ``resilience.emergency_saves`` (``resilience.rollbacks`` is owned by
+  `guard.StepGuard`).
+
+Directory layout contract (also in ``docs/RESILIENCE.md``)::
+
+    <root>/
+      step_000010/
+        0.metadata          sharded-tensor index (distributed/checkpoint)
+        <dev>_0.distcp      safetensors shard files, per-tensor crc32
+        extra_state.json    step / rng / optimizer scalars / scaler / extras
+        COMPLETE            {"step": N, "files": {name: {size, crc32}}}
+      QUARANTINED-step_000011/   torn save, quarantined by latest_valid()
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+import zlib
+from types import SimpleNamespace
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..distributed.checkpoint import CheckpointCorrupt, load_state_dict
+from ..distributed.checkpoint.errors import AsyncSaveError
+from ..distributed.checkpoint.load_state_dict import _read_metadata
+from ..distributed.checkpoint.save_state_dict import (_SaveThread,
+                                                      snapshot_state_dict,
+                                                      write_snapshot)
+from ..framework import monitor
+from ..framework.random import get_rng_state, set_rng_state
+from ..framework.retry import retry_call
+from ..framework.safetensors import np_dtype
+from . import faults
+
+__all__ = ["CheckpointManager"]
+
+STEP_DIR_RE = re.compile(r"^step_(\d{6,})$")
+QUARANTINE_PREFIX = "QUARANTINED-"
+_MODEL = "model."
+_OPT = "opt."
+
+
+def _step_dirname(step: int) -> str:
+    return f"step_{step:06d}"
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+class CheckpointManager:
+    def __init__(self, root: str, keep_last_n: int = 3,
+                 keep_every_k: Optional[int] = None,
+                 async_save: bool = False,
+                 retries: int = 2, retry_base_delay: float = 0.05,
+                 retry_max_delay: float = 1.0,
+                 retry_deadline: Optional[float] = 30.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if keep_last_n < 1:
+            raise ValueError("keep_last_n must be >= 1")
+        if keep_every_k is not None and keep_every_k < 1:
+            raise ValueError("keep_every_k must be >= 1 (or None)")
+        self.root = os.path.abspath(root)
+        self.keep_last_n = int(keep_last_n)
+        self.keep_every_k = keep_every_k
+        self.async_save = bool(async_save)
+        self._retry_kw = dict(retries=retries, base_delay=retry_base_delay,
+                              max_delay=retry_max_delay,
+                              deadline=retry_deadline, sleep=sleep,
+                              monitor_name="resilience.retries")
+        self._pending: Optional[_SaveThread] = None
+        self._deferred_error: Optional[AsyncSaveError] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, model=None, optimizer=None, scaler=None,
+             extras: Optional[dict] = None, state_dict: Optional[dict] = None,
+             async_save: Optional[bool] = None) -> str:
+        """Write checkpoint ``step``. Returns the directory path (for an
+        async save, the path the background thread is writing).
+
+        A failure captured from a *previous* async save is re-raised here,
+        on the caller's thread, before anything else happens — background
+        errors never pass silently.
+        """
+        self._join_pending()  # ordering + re-raise captured async error
+        snap, extra = self._snapshot(step, model, optimizer, scaler, extras,
+                                     state_dict)
+        path = os.path.join(self.root, _step_dirname(step))
+        use_async = self.async_save if async_save is None else async_save
+        if use_async:
+            self._pending = _SaveThread(
+                lambda: self._write(path, step, snap, extra))
+            self._pending.start()
+        else:
+            self._write(path, step, snap, extra)
+        return path
+
+    def emergency_save(self, step: int, model=None, optimizer=None,
+                       scaler=None, extras: Optional[dict] = None,
+                       state_dict: Optional[dict] = None) -> str:
+        """One synchronous, no-backoff save on the way down (SIGTERM /
+        preemption notice). Single attempt: a dying process has no time
+        budget for retries."""
+        self._join_pending(swallow=True)  # the emergency write wins
+        path = os.path.join(self.root, _step_dirname(step))
+        try:
+            # a verified checkpoint for this step already exists (e.g.
+            # save_every just fired): do NOT rmtree-and-rewrite it — the
+            # preemptor's follow-up SIGKILL mid-rewrite would destroy the
+            # newest valid checkpoint, the exact loss this hook prevents.
+            # Existence+size only: a full crc32 re-read of a multi-GB
+            # checkpoint could eat the whole preemption grace window, and
+            # byte-level rot is caught by latest_valid() on resume anyway
+            self._verify_dir(path, crc=False)
+        except CheckpointCorrupt:
+            snap, extra = self._snapshot(step, model, optimizer, scaler,
+                                         extras, state_dict)
+            self._write(path, step, snap, extra, retries=0)
+        monitor.inc("resilience.emergency_saves")
+        return path
+
+    def wait(self) -> None:
+        """Block until any pending async save lands; re-raise its failure."""
+        self._join_pending()
+
+    def _join_pending(self, swallow: bool = False) -> None:
+        """Join the pending async writer. Its captured failure raises here,
+        on the caller's thread — except with ``swallow=True`` (latest_valid
+        must not explode mid-recovery; emergency_save is dying), where it
+        is *deferred* and re-raised at the next save()/wait() so it still
+        never passes silently."""
+        th, self._pending = self._pending, None
+        if th is not None:
+            th.join()
+            if th.error is not None:
+                err = th.error if isinstance(th.error, AsyncSaveError) \
+                    else AsyncSaveError(self.root, th.error)
+                if swallow:
+                    self._deferred_error = err
+                else:
+                    raise err
+        if not swallow and self._deferred_error is not None:
+            err, self._deferred_error = self._deferred_error, None
+            raise err
+
+    def _snapshot(self, step, model, optimizer, scaler, extras, state_dict):
+        """Capture everything on the caller's thread, COPIED TO HOST.
+
+        Holding jax array references is not a snapshot: the optimizer's
+        fused step donates the previous param/moment buffers, so by the
+        time a background writer (or a sync retry) reads them they are
+        deleted arrays. ``snapshot_state_dict`` materialises every shard
+        to numpy here, making the write side pure I/O."""
+        flat: Dict[str, Tensor] = {}
+        src = state_dict if state_dict is not None else (
+            model.state_dict() if model is not None else {})
+        for k, t in src.items():
+            flat[_MODEL + k] = t if isinstance(t, Tensor) \
+                else Tensor(np.asarray(t))
+        opt_scalars = {}
+        if optimizer is not None:
+            for k, v in optimizer.state_dict().items():
+                if isinstance(v, Tensor):
+                    flat[_OPT + k] = v
+                else:  # global_step int, LR_Scheduler dict — JSON-able
+                    opt_scalars[k] = v
+        extra = {
+            "step": int(step),
+            "rng": [list(s) for s in get_rng_state()],
+            "opt_scalars": opt_scalars,
+            "scaler": self._scaler_state(scaler),
+            "extras": extras or {},
+        }
+        return snapshot_state_dict(flat), extra
+
+    @staticmethod
+    def _scaler_state(scaler) -> Optional[dict]:
+        if scaler is None:
+            return None
+        st = dict(scaler.state_dict())
+        if "scale" in st:
+            st["scale"] = float(np.asarray(st["scale"]))
+        return st
+
+    def _write(self, path: str, step: int, snap, extra: dict,
+               retries: Optional[int] = None) -> None:
+        from ..profiler import RecordEvent
+
+        with RecordEvent(f"resilience.save[{_step_dirname(step)}]"):
+            self._write_inner(path, step, snap, extra, retries)
+
+    @staticmethod
+    def _barrier(tag: str) -> None:
+        """Cross-process sync point for multi-host jobs writing one shared
+        checkpoint directory; a no-op in the (usual) single-process case."""
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
+
+    def _write_inner(self, path, step, snap, extra, retries):
+        import jax
+
+        coord = jax.process_index() == 0
+        if coord and os.path.isdir(path):  # torn earlier attempt, coord only
+            shutil.rmtree(path)
+        self._barrier(f"resilience.pre.{step}")   # rmtree before any write
+        os.makedirs(path, exist_ok=True)
+
+        def attempt():
+            faults.check("ckpt.write")
+            write_snapshot(snap, path)  # pure host I/O: retry-safe
+            if coord:
+                tmp = os.path.join(path, "extra_state.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(extra, f)
+                os.replace(tmp, os.path.join(path, "extra_state.json"))
+
+        kw = dict(self._retry_kw)
+        if retries is not None:
+            kw["retries"] = retries
+        retry_call(attempt, **kw)
+        # every rank's shards must be on disk before the coordinator lists
+        # the directory for the manifest — and only the coordinator
+        # publishes COMPLETE and prunes (a peer racing ahead would
+        # manifest a directory whose shard files are still half-written)
+        self._barrier(f"resilience.shards.{step}")
+        if not coord:
+            return
+        faults.check("ckpt.complete")
+        # the COMPLETE manifest is written last, atomically: its presence
+        # asserts "every file below existed with these exact bytes"
+        manifest = {"step": step, "files": {}}
+        for name in sorted(os.listdir(path)):
+            fp = os.path.join(path, name)
+            manifest["files"][name] = {"size": os.path.getsize(fp),
+                                       "crc32": _file_crc32(fp)}
+        tmp = os.path.join(path, "COMPLETE.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(path, "COMPLETE"))
+        monitor.inc("resilience.saves")
+        self._apply_retention()
+
+    # -- retention ----------------------------------------------------------
+    def _complete_steps(self):
+        """[(step, dirname)] of COMPLETE checkpoints, ascending."""
+        out = []
+        for name in os.listdir(self.root):
+            m = STEP_DIR_RE.match(name)
+            if m and os.path.exists(os.path.join(self.root, name,
+                                                 "COMPLETE")):
+                out.append((int(m.group(1)), name))
+        return sorted(out)
+
+    def _apply_retention(self) -> None:
+        steps = self._complete_steps()
+        keep = {name for _, name in steps[-self.keep_last_n:]}
+        if self.keep_every_k:
+            keep |= {name for s, name in steps
+                     if s % self.keep_every_k == 0}
+        for _, name in steps:
+            if name not in keep:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    # -- verified resume ----------------------------------------------------
+    def _verify_dir(self, path: str, crc: bool = True) -> None:
+        """Raise CheckpointCorrupt unless ``path`` matches its COMPLETE
+        manifest byte-for-byte (existence, size, crc32 of every file).
+        ``crc=False`` stops at existence+size (cheap stats) for callers on
+        a deadline (the SIGTERM emergency path)."""
+        marker = os.path.join(path, "COMPLETE")
+        if not os.path.exists(marker):
+            raise CheckpointCorrupt(path, "no COMPLETE marker (torn save)",
+                                    file="COMPLETE")
+        try:
+            with open(marker) as f:
+                manifest = json.load(f)
+        except (json.JSONDecodeError, OSError) as exc:
+            raise CheckpointCorrupt(path, f"unreadable COMPLETE: {exc}",
+                                    file="COMPLETE")
+        if "0.metadata" not in manifest.get("files", {}):
+            # a manifest published without the coordinator's index is not
+            # a loadable checkpoint no matter what else it lists
+            raise CheckpointCorrupt(path, "COMPLETE manifest lacks the "
+                                    "0.metadata index", file="0.metadata")
+        for name, want in manifest.get("files", {}).items():
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                raise CheckpointCorrupt(path, "file in COMPLETE manifest "
+                                        "is missing", file=name)
+            if os.path.getsize(fp) != want["size"]:
+                raise CheckpointCorrupt(
+                    path, f"size mismatch ({os.path.getsize(fp)} != "
+                    f"{want['size']})", file=name)
+            if crc and _file_crc32(fp) != want["crc32"]:
+                raise CheckpointCorrupt(path, "crc32 mismatch", file=name)
+
+    def _quarantine(self, name: str) -> None:
+        src = os.path.join(self.root, name)
+        dst = os.path.join(self.root, QUARANTINE_PREFIX + name)
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.root, f"{QUARANTINE_PREFIX}{name}.{n}")
+        os.rename(src, dst)
+        monitor.inc("resilience.quarantines")
+
+    def latest_valid(self):
+        """Newest checkpoint that passes full manifest verification, as
+        ``(step, path)`` — or None. Directories that fail (no COMPLETE,
+        missing/short/corrupt file) are renamed ``QUARANTINED-<name>`` and
+        skipped, so a resume never loads a torn save and never retries a
+        known-bad one."""
+        self._join_pending(swallow=True)  # don't race a pending writer
+        names = sorted((name for name in os.listdir(self.root)
+                        if STEP_DIR_RE.match(name)), reverse=True)
+        for name in names:
+            path = os.path.join(self.root, name)
+            try:
+                self._verify_dir(path)
+            except CheckpointCorrupt:
+                self._quarantine(name)
+                continue
+            return int(STEP_DIR_RE.match(name).group(1)), path
+        return None
+
+    # -- load ---------------------------------------------------------------
+    def load(self, path: str, model=None, optimizer=None, scaler=None,
+             state_dict: Optional[dict] = None) -> SimpleNamespace:
+        """Restore ``path`` into the given objects IN PLACE (model tensors
+        resharded to their current placement, optimizer accumulators
+        rebuilt exactly, RNG + scaler state reset) and return
+        ``SimpleNamespace(step, extras)``."""
+        # the manager's own async writer bypasses save_state_dict's pending
+        # registry, so loading the path an async save() just returned must
+        # join it here (error deferred, not lost — next save()/wait() raises)
+        self._join_pending(swallow=True)
+        with open(os.path.join(path, "extra_state.json")) as f:
+            extra = json.load(f)
+        dest: Dict[str, object] = {}
+        src = state_dict if state_dict is not None else (
+            model.state_dict() if model is not None else {})
+        for k, t in src.items():
+            dest[_MODEL + k] = t  # live tensors: loaded in place, resharded
+        meta = _read_metadata(path)
+        opt_keys = [k for k in meta.state_dict_metadata if
+                    k.startswith(_OPT)]
+        if optimizer is not None:
+            # accumulators may not exist yet on a fresh optimizer; their
+            # shapes/dtypes come from the checkpoint's own index
+            for k in opt_keys:
+                m = meta.state_dict_metadata[k][0]
+                shape = m.global_shape or m.local_shape
+                dest[k] = np.zeros(shape, dtype=np_dtype(m.dtype))
+        load_state_dict(dest, path)
+        if optimizer is not None:
+            opt_sd = {k[len(_OPT):]: Tensor(dest[k]) for k in opt_keys}
+            if opt_sd:
+                # accumulator keys are `<param.name>_<acc>`; a resume into
+                # an optimizer whose params were named differently (e.g. a
+                # second model built in the same process, shifting the
+                # auto-name counter) would otherwise drop ALL state
+                # silently and "resume" with zeroed moments
+                pnames = {p.name for p in optimizer._params
+                          if isinstance(p, Tensor)}
+                if not any(k.startswith(n) for k in opt_sd for n in pnames):
+                    raise RuntimeError(
+                        "checkpoint optimizer state matches none of this "
+                        "optimizer's parameter names — the model must be "
+                        "constructed identically (same order, fresh "
+                        "process) for accumulator names to line up")
+            opt_sd.update(extra.get("opt_scalars", {}))
+            optimizer.set_state_dict(opt_sd)
+        if scaler is not None and extra.get("scaler"):
+            scaler.load_state_dict(extra["scaler"])
+        if extra.get("rng"):
+            set_rng_state([tuple(s) for s in extra["rng"]])
+        return SimpleNamespace(step=int(extra["step"]),
+                               extras=extra.get("extras", {}))
+
+    def restore_latest(self, model=None, optimizer=None, scaler=None,
+                       state_dict: Optional[dict] = None):
+        """`latest_valid()` + `load()`; None when no valid checkpoint
+        exists."""
+        found = self.latest_valid()
+        if found is None:
+            return None
+        _, path = found
+        return self.load(path, model=model, optimizer=optimizer,
+                         scaler=scaler, state_dict=state_dict)

@@ -1,0 +1,129 @@
+"""Deterministic fault-injection registry.
+
+The resilience subsystem is only trustworthy if every failure path it
+claims to handle is *exercised*, not just written. This registry lets a
+test (or `tools/crash_resume_smoke.py`) arm a named site —
+``inject("ckpt.write", after_n=3)`` — and the instrumented production code
+calls ``check(site)`` / ``fires(site)`` at that site. Counting is purely
+arithmetic over call order, so a given injection schedule replays
+identically on every run: no clocks, no randomness, no sleeps.
+
+Fault kinds (``action``):
+- ``"raise"``  — raise ``exc`` (default :class:`InjectedIOError`) at the
+  site: models transient/permanent I/O failures and step exceptions.
+- ``"kill"``   — ``SIGKILL`` the current process: models hard preemption
+  mid-operation (no cleanup runs, exactly like a real preempt).
+- ``"sigterm"``— deliver ``SIGTERM`` to the current process: models a
+  graceful-preemption notice (exercises the StepGuard emergency-save
+  hook in-process).
+- ``"flag"``   — no side effect at ``check``; the site observes it via
+  :func:`fires` and reacts itself (e.g. StepGuard substitutes a NaN
+  loss).
+
+Instrumented sites in this build: ``ckpt.write`` (per shard-write
+attempt), ``ckpt.complete`` (before the COMPLETE marker),
+``guard.step`` (before the wrapped train step runs), ``guard.nan_loss``
+(flag: poison the step's loss), ``guard.preempt`` (before the step,
+for kill/sigterm).
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import threading
+from typing import Dict, Optional
+
+__all__ = ["InjectedFault", "InjectedIOError", "inject", "clear", "check",
+           "fires", "state"]
+
+
+class InjectedFault(Exception):
+    """Base class for all injected failures."""
+
+
+class InjectedIOError(InjectedFault, IOError):
+    """Injected transient/permanent I/O failure (an ``OSError`` subclass,
+    so it flows through `framework.retry`'s default ``retry_on``)."""
+
+
+class _Rule:
+    __slots__ = ("site", "after_n", "times", "action", "exc", "calls",
+                 "fired")
+
+    def __init__(self, site, after_n, times, action, exc):
+        self.site = site
+        self.after_n = int(after_n)   # calls that pass before firing starts
+        self.times = times            # firings allowed; None = unlimited
+        self.action = action
+        self.exc = exc
+        self.calls = 0                # calls seen
+        self.fired = 0                # firings delivered
+
+
+_rules: Dict[str, _Rule] = {}
+_lock = threading.Lock()
+
+
+def inject(site: str, after_n: int = 0, times: Optional[int] = 1,
+           action: str = "raise", exc=None) -> None:
+    """Arm ``site``: the first ``after_n`` calls pass, then the next
+    ``times`` calls fire (``times=None`` fires forever)."""
+    if action not in ("raise", "kill", "sigterm", "flag"):
+        raise ValueError(f"unknown fault action {action!r}")
+    with _lock:
+        _rules[site] = _Rule(site, after_n, times, action,
+                             exc or InjectedIOError(f"injected fault at "
+                                                    f"'{site}'"))
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site when ``site`` is None."""
+    with _lock:
+        if site is None:
+            _rules.clear()
+        else:
+            _rules.pop(site, None)
+
+
+def _consume(site: str) -> Optional[_Rule]:
+    """Count one call at ``site``; return the rule iff this call fires."""
+    with _lock:
+        rule = _rules.get(site)
+        if rule is None:
+            return None
+        rule.calls += 1
+        if rule.calls <= rule.after_n:
+            return None
+        if rule.times is not None and rule.fired >= rule.times:
+            return None
+        rule.fired += 1
+        return rule
+
+
+def fires(site: str) -> bool:
+    """Count one call; True iff the site fires now. Used by ``"flag"``
+    sites where the caller applies the fault itself."""
+    return _consume(site) is not None
+
+
+def check(site: str) -> None:
+    """Count one call; deliver the armed fault (raise / kill / sigterm)
+    if this call fires. A ``"flag"`` rule never raises from ``check``."""
+    rule = _consume(site)
+    if rule is None or rule.action == "flag":
+        return
+    if rule.action == "kill":
+        os.kill(os.getpid(), _signal.SIGKILL)
+    if rule.action == "sigterm":
+        os.kill(os.getpid(), _signal.SIGTERM)
+        return  # handler (if any) ran; the site continues
+    raise rule.exc
+
+
+def state() -> Dict[str, Dict[str, int]]:
+    """Introspection for tests: per-site call/fire counts."""
+    with _lock:
+        return {s: {"calls": r.calls, "fired": r.fired,
+                    "after_n": r.after_n,
+                    "times": -1 if r.times is None else r.times}
+                for s, r in _rules.items()}

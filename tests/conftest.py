@@ -21,6 +21,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests (excluded from "
+        "the tier-1 `-m 'not slow'` run)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

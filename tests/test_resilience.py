@@ -1,0 +1,719 @@
+"""Fault-tolerant training runtime (`paddle_tpu/resilience/`).
+
+Every failure path is driven through the deterministic fault-injection
+registry (`resilience/faults.py`) — no sleeps, no timing races in the
+non-slow tests. Covers: save/rotate/retention, torn-checkpoint quarantine
+and `latest_valid()` fallback, async-save error re-raise, retry/backoff
+deadline semantics, StepGuard NaN/spike rollback with exact state + RNG
+restore, GradScaler skip composition, SIGTERM emergency save (in-process
+signal), elastic heartbeat reaping, and the typed `CheckpointCorrupt`
+load-path errors. The crash-kill/resume integration run is `slow`.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (AsyncSaveError,
+                                               CheckpointCorrupt)
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.random import get_rng_state
+from paddle_tpu.framework.retry import RetryDeadlineExceeded, retry_call
+from paddle_tpu.resilience import (CheckpointManager, NoValidCheckpoint,
+                                   Preempted, RestartBudgetExceeded,
+                                   StepGuard, faults)
+from paddle_tpu.resilience.checkpoint_manager import QUARANTINE_PREFIX
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def small_state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": paddle.Tensor(rng.standard_normal((4, 4)).astype("float32")),
+            "b": paddle.Tensor(rng.standard_normal((4,)).astype("float32"))}
+
+
+def make_manager(tmp_path, **kw):
+    kw.setdefault("sleep", lambda s: None)  # unit tests never really sleep
+    return CheckpointManager(str(tmp_path / "ckpt"), **kw)
+
+
+def complete_dirs(root):
+    return sorted(d for d in os.listdir(root)
+                  if d.startswith("step_")
+                  and os.path.exists(os.path.join(root, d, "COMPLETE")))
+
+
+# ---------------------------------------------------------------------------
+# framework/retry.py
+# ---------------------------------------------------------------------------
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise IOError("transient")
+            return "ok"
+
+        before = monitor.get("framework.retries")
+        out = retry_call(flaky, retries=5, base_delay=0.1, jitter=0.0,
+                         sleep=sleeps.append)
+        assert out == "ok" and calls["n"] == 3
+        assert sleeps == [0.1, 0.2]  # exponential backoff
+        assert monitor.get("framework.retries") - before == 2
+
+    def test_gives_up_after_retries(self):
+        sleeps = []
+        with pytest.raises(IOError):
+            retry_call(lambda: (_ for _ in ()).throw(IOError("perm")),
+                       retries=2, base_delay=0.01, jitter=0.0,
+                       sleep=sleeps.append)
+        assert len(sleeps) == 2
+
+    def test_deadline_exceeded(self):
+        t = {"now": 0.0}
+
+        def clock():
+            return t["now"]
+
+        def sleep(s):
+            t["now"] += s
+
+        with pytest.raises(RetryDeadlineExceeded) as ei:
+            retry_call(lambda: (_ for _ in ()).throw(IOError("x")),
+                       retries=1000, base_delay=1.0, max_delay=1.0,
+                       jitter=0.0, deadline=3.5, sleep=sleep, clock=clock)
+        assert isinstance(ei.value.__cause__, IOError)
+        assert t["now"] == pytest.approx(3.0)  # 4th sleep would cross 3.5
+
+    def test_jitter_is_deterministic(self):
+        def run():
+            sleeps = []
+            try:
+                retry_call(lambda: (_ for _ in ()).throw(IOError()),
+                           retries=3, base_delay=0.1, jitter=0.5,
+                           sleep=sleeps.append, seed=42)
+            except IOError:
+                pass
+            return sleeps
+
+        assert run() == run()
+
+    def test_non_retryable_raises_immediately(self):
+        with pytest.raises(ValueError):
+            retry_call(lambda: (_ for _ in ()).throw(ValueError()),
+                       retries=5, sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# resilience/faults.py
+# ---------------------------------------------------------------------------
+class TestFaultInjection:
+    def test_after_n_times_schedule_is_deterministic(self):
+        faults.inject("x", after_n=2, times=2)
+        fired = [faults.fires("x") for _ in range(6)]
+        assert fired == [False, False, True, True, False, False]
+        st = faults.state()["x"]
+        assert st["calls"] == 6 and st["fired"] == 2
+
+    def test_check_raises_typed_ioerror(self):
+        faults.inject("io", times=1)
+        with pytest.raises(faults.InjectedIOError):
+            faults.check("io")
+        faults.check("io")  # exhausted: passes
+
+    def test_unlimited_and_clear(self):
+        faults.inject("y", times=None)
+        assert all(faults.fires("y") for _ in range(5))
+        faults.clear("y")
+        assert not faults.fires("y")
+
+    def test_custom_exception(self):
+        faults.inject("z", exc=RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="boom"):
+            faults.check("z")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: save / rotate / retention
+# ---------------------------------------------------------------------------
+class TestSaveRetention:
+    def test_save_layout_and_rotation(self, tmp_path):
+        cm = make_manager(tmp_path, keep_last_n=2)
+        st = small_state()
+        for step in range(5):
+            p = cm.save(step, state_dict=st)
+        assert sorted(os.listdir(p)) == ["0.metadata", "0_0.distcp",
+                                         "COMPLETE", "extra_state.json"]
+        assert complete_dirs(cm.root) == ["step_000003", "step_000004"]
+
+    def test_milestones_survive_rotation(self, tmp_path):
+        cm = make_manager(tmp_path, keep_last_n=2, keep_every_k=5)
+        st = small_state()
+        for step in range(1, 13):
+            cm.save(step, state_dict=st)
+        # rolling last-2 plus the step%5==0 milestones
+        assert complete_dirs(cm.root) == ["step_000005", "step_000010",
+                                          "step_000011", "step_000012"]
+
+    def test_save_retries_transient_io_then_succeeds(self, tmp_path):
+        cm = make_manager(tmp_path, retries=3)
+        before = monitor.get("resilience.retries")
+        faults.inject("ckpt.write", times=2)
+        cm.save(0, state_dict=small_state())
+        assert monitor.get("resilience.retries") - before == 2
+        assert cm.latest_valid()[0] == 0
+
+    def test_save_gives_up_on_persistent_io(self, tmp_path):
+        cm = make_manager(tmp_path, retries=2)
+        faults.inject("ckpt.write", times=None)
+        with pytest.raises(faults.InjectedIOError):
+            cm.save(0, state_dict=small_state())
+        assert cm.latest_valid() is None  # nothing valid was left behind
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: torn-checkpoint quarantine + latest_valid
+# ---------------------------------------------------------------------------
+class TestQuarantine:
+    def _saved(self, tmp_path, n=3):
+        cm = make_manager(tmp_path, keep_last_n=10)
+        st = small_state()
+        for step in range(n):
+            cm.save(step, state_dict=st)
+        return cm
+
+    def test_missing_complete_marker_is_skipped_and_quarantined(
+            self, tmp_path):
+        cm = self._saved(tmp_path)
+        os.remove(os.path.join(cm.root, "step_000002", "COMPLETE"))
+        before = monitor.get("resilience.quarantines")
+        step, path = cm.latest_valid()
+        assert step == 1 and path.endswith("step_000001")
+        assert os.path.isdir(os.path.join(
+            cm.root, QUARANTINE_PREFIX + "step_000002"))
+        assert monitor.get("resilience.quarantines") - before == 1
+
+    def test_truncated_shard_is_quarantined(self, tmp_path):
+        cm = self._saved(tmp_path)
+        shard = os.path.join(cm.root, "step_000002", "0_0.distcp")
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) - 8)
+        assert cm.latest_valid()[0] == 1
+
+    def test_bitflip_crc_mismatch_is_quarantined(self, tmp_path):
+        cm = self._saved(tmp_path)
+        shard = os.path.join(cm.root, "step_000002", "0_0.distcp")
+        size = os.path.getsize(shard)
+        with open(shard, "r+b") as f:
+            f.seek(size - 3)
+            b = f.read(1)
+            f.seek(size - 3)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert os.path.getsize(shard) == size  # same size: only crc catches it
+        assert cm.latest_valid()[0] == 1
+
+    def test_all_torn_returns_none(self, tmp_path):
+        cm = self._saved(tmp_path, n=2)
+        for d in complete_dirs(cm.root):
+            os.remove(os.path.join(cm.root, d, "COMPLETE"))
+        assert cm.latest_valid() is None
+
+    def test_quarantined_dirs_never_reload(self, tmp_path):
+        cm = self._saved(tmp_path)
+        os.remove(os.path.join(cm.root, "step_000002", "COMPLETE"))
+        cm.latest_valid()
+        # the quarantined name no longer matches step dirs: a second scan
+        # must not see (or re-quarantine) it
+        before = monitor.get("resilience.quarantines")
+        assert cm.latest_valid()[0] == 1
+        assert monitor.get("resilience.quarantines") == before
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: async saves
+# ---------------------------------------------------------------------------
+class TestAsyncSave:
+    def test_async_save_completes_and_next_save_joins(self, tmp_path):
+        cm = make_manager(tmp_path, async_save=True)
+        st = small_state()
+        cm.save(0, state_dict=st)
+        cm.save(1, state_dict=st)   # joins save 0 first
+        cm.wait()
+        assert complete_dirs(cm.root) == ["step_000000", "step_000001"]
+
+    def test_background_error_reraised_at_next_save(self, tmp_path):
+        cm = make_manager(tmp_path, async_save=True, retries=0)
+        faults.inject("ckpt.write", times=1)
+        cm.save(0, state_dict=small_state())  # fails in the background
+        with pytest.raises(AsyncSaveError):
+            cm.save(1, state_dict=small_state())
+        cm.save(2, state_dict=small_state())  # error was consumed
+        cm.wait()
+        assert cm.latest_valid()[0] == 2
+
+    def test_background_error_reraised_at_wait(self, tmp_path):
+        cm = make_manager(tmp_path, async_save=True, retries=0)
+        faults.inject("ckpt.write", times=1)
+        cm.save(0, state_dict=small_state())
+        with pytest.raises(AsyncSaveError):
+            cm.wait()
+
+    def test_error_swallowed_by_latest_valid_is_deferred_not_lost(
+            self, tmp_path):
+        cm = make_manager(tmp_path, async_save=True, retries=0)
+        st = small_state()
+        cm.save(0, state_dict=st)
+        cm.wait()
+        faults.inject("ckpt.write", times=1)
+        cm.save(1, state_dict=st)      # fails in the background
+        # mid-recovery scan must not explode, but the failure is deferred
+        assert cm.latest_valid()[0] == 0
+        with pytest.raises(AsyncSaveError):
+            cm.save(2, state_dict=st)
+
+    def test_emergency_save_does_not_destroy_existing_checkpoint(
+            self, tmp_path):
+        cm = make_manager(tmp_path)
+        st = small_state()
+        cm.save(3, state_dict=st)
+        marker = os.path.join(cm.root, "step_000003", "COMPLETE")
+        mtime = os.path.getmtime(marker)
+        # emergency at a step that is already safely on disk: the existing
+        # verified directory must be left untouched (a SIGKILL mid-rewrite
+        # would otherwise destroy the newest valid checkpoint)
+        cm.emergency_save(3, state_dict=st)
+        assert os.path.getmtime(marker) == mtime
+        assert cm.latest_valid()[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# distributed/checkpoint satellites
+# ---------------------------------------------------------------------------
+class TestDistCheckpointHardening:
+    def test_async_thread_exception_reraised_per_path(self, tmp_path,
+                                                      monkeypatch):
+        import paddle_tpu.distributed as dist
+        import importlib
+
+        ssd = importlib.import_module(
+            "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+        # a background write failure must not vanish with its thread
+        def failing_write(*a, **kw):
+            raise IOError("disk gone")
+
+        monkeypatch.setattr(ssd.sft, "save_file", failing_write)
+        st = {"w": paddle.Tensor(np.ones((2, 2), np.float32))}
+        dist.save_state_dict(st, str(tmp_path / "a"), async_save=True)
+        with pytest.raises(AsyncSaveError):
+            ssd._wait_pending(str(tmp_path / "a"))
+        # consumed: a second wait on the same path is clean
+        ssd._wait_pending(str(tmp_path / "a"))
+
+    def test_second_async_save_same_path_does_not_interleave(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        import importlib
+
+        ssd = importlib.import_module(
+            "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+        path = str(tmp_path / "ck")
+        order = []
+        gate = threading.Event()
+        orig = ssd.sft.save_file
+
+        def slow_save(tensors, p, metadata=None):
+            order.append("start")
+            gate.wait(5)
+            orig(tensors, p, metadata=metadata)
+            order.append("end")
+
+        ssd.sft.save_file = slow_save
+        try:
+            st = {"w": paddle.Tensor(np.ones((2, 2), np.float32))}
+            dist.save_state_dict(st, path, async_save=True)
+            t = threading.Thread(target=dist.save_state_dict,
+                                 args=(st, path), kwargs={"async_save": True})
+            t.start()
+            gate.set()   # first writer finishes; second may then start
+            t.join()
+            ssd._wait_pending(path)
+        finally:
+            ssd.sft.save_file = orig
+        # strict nesting: start,end,start,end — never start,start
+        assert order == ["start", "end", "start", "end"]
+
+    def test_missing_shard_file_raises_typed_error(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        st = {"w": paddle.Tensor(np.arange(16, dtype=np.float32)
+                                 .reshape(4, 4))}
+        dist.save_state_dict(st, str(tmp_path))
+        os.remove(tmp_path / "0_0.distcp")
+        dest = {"w": paddle.Tensor(np.zeros((4, 4), np.float32))}
+        with pytest.raises(CheckpointCorrupt) as ei:
+            dist.load_state_dict(dest, str(tmp_path))
+        assert ei.value.file == "0_0.distcp" and ei.value.key == "w"
+
+    def test_short_shard_file_raises_typed_error(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        st = {"w": paddle.Tensor(np.arange(64, dtype=np.float32))}
+        dist.save_state_dict(st, str(tmp_path))
+        shard = tmp_path / "0_0.distcp"
+        with open(shard, "r+b") as f:
+            f.truncate(os.path.getsize(shard) - 16)
+        dest = {"w": paddle.Tensor(np.zeros(64, np.float32))}
+        with pytest.raises(CheckpointCorrupt, match="truncated"):
+            dist.load_state_dict(dest, str(tmp_path))
+
+    def test_missing_metadata_raises_typed_error(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        dest = {"w": paddle.Tensor(np.zeros(4, np.float32))}
+        with pytest.raises(CheckpointCorrupt, match="0.metadata"):
+            dist.load_state_dict(dest, str(tmp_path))
+
+    def test_crc_mismatch_on_read_raises_typed_error(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        st = {"w": paddle.Tensor(np.arange(64, dtype=np.float32))}
+        dist.save_state_dict(st, str(tmp_path))
+        shard = tmp_path / "0_0.distcp"
+        size = os.path.getsize(shard)
+        with open(shard, "r+b") as f:
+            f.seek(size - 5)
+            f.write(b"\xff")
+        dest = {"w": paddle.Tensor(np.zeros(64, np.float32))}
+        with pytest.raises(CheckpointCorrupt, match="integrity"):
+            dist.load_state_dict(dest, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# StepGuard
+# ---------------------------------------------------------------------------
+def tiny_training(seed=3):
+    paddle.seed(seed)
+    m = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=m.parameters())
+    x = paddle.Tensor(np.random.default_rng(0)
+                      .standard_normal((8, 4)).astype("float32"))
+
+    def step_fn(step_idx):
+        y = m(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return m, opt, step_fn
+
+
+class TestStepGuard:
+    def test_nan_rollback_restores_exact_params_and_rng(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          save_every=1)
+        for i in range(3):
+            assert guard.step(i) is not None
+        snap = {k: np.asarray(t._data).copy()
+                for k, t in m.state_dict().items()}
+        rng_snap = get_rng_state()
+        before = monitor.get("resilience.rollbacks")
+        faults.inject("guard.nan_loss", times=1)
+        assert guard.step(3) is None  # tripped + rolled back
+        assert monitor.get("resilience.rollbacks") - before == 1
+        for k, t in m.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(t._data), snap[k])
+        assert get_rng_state() == rng_snap
+        assert guard.last_step == 2  # resume point
+        # the replayed step now succeeds
+        assert guard.step(3) is not None
+
+    def test_restart_budget_exceeded(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          max_restarts=2)
+        cm.save(0, model=m, optimizer=opt)
+        faults.inject("guard.nan_loss", times=None)
+        assert guard.step(1) is None
+        assert guard.step(1) is None
+        with pytest.raises(RestartBudgetExceeded):
+            guard.step(1)
+
+    def test_trip_without_checkpoint_raises(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        guard = StepGuard(step_fn, make_manager(tmp_path),
+                          model=m, optimizer=opt)
+        faults.inject("guard.nan_loss", times=1)
+        with pytest.raises(NoValidCheckpoint):
+            guard.step(0)
+
+    def test_step_exception_trips(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        cm.save(0, model=m, optimizer=opt)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt)
+        faults.inject("guard.step", times=1, exc=RuntimeError("XLA died"))
+        assert guard.step(1) is None
+        assert monitor.get("resilience.trips.exception") >= 1
+
+    def test_loss_spike_trips_with_configured_window(self, tmp_path):
+        losses = iter([1.0, 1.1, 0.9, 1.0, 50.0])
+        cm = make_manager(tmp_path)
+        m, opt, _ = tiny_training()
+        cm.save(0, model=m, optimizer=opt)
+        guard = StepGuard(lambda i: next(losses), cm, model=m,
+                          optimizer=opt, window=4, threshold=10.0)
+        for i in range(4):
+            assert guard.step(i) is not None
+        assert guard.step(4) is None  # 50 > 10 * median(~1.0)
+        assert monitor.get("resilience.trips.loss_spike") >= 1
+
+    def test_grad_norm_spike_trips(self, tmp_path):
+        vals = iter([(1.0, 1.0)] * 3 + [(1.0, 99.0)])
+        cm = make_manager(tmp_path)
+        m, opt, _ = tiny_training()
+        cm.save(0, model=m, optimizer=opt)
+        guard = StepGuard(lambda i: next(vals), cm, model=m, optimizer=opt,
+                          window=3, threshold=5.0)
+        for i in range(3):
+            assert guard.step(i) is not None
+        assert guard.step(3) is None
+        assert monitor.get("resilience.trips.grad_spike") >= 1
+
+    def test_scaler_skip_is_not_an_anomaly_but_streak_trips(self, tmp_path):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        p = paddle.Tensor(np.ones(4, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+
+        def amp_step(step_idx, bad):
+            y = (p * (np.inf if bad else 1.0)).sum()
+            scaled = scaler.scale(y)
+            scaled.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return float(1.0)
+
+        cm = make_manager(tmp_path)
+        cm.save(0, state_dict={"p": p})
+        guard = StepGuard(amp_step, cm, scaler=scaler, max_scaler_skips=2)
+        # a single found-inf skip: loss returned, no trip, no rollback
+        before = monitor.get("resilience.rollbacks")
+        assert guard.step(1, True) == 1.0
+        assert scaler.last_step_skipped()
+        assert monitor.get("resilience.rollbacks") == before
+        # good step resets the streak
+        assert guard.step(2, False) == 1.0
+        assert not scaler.last_step_skipped()
+        # a streak past max_scaler_skips trips
+        assert guard.step(3, True) == 1.0
+        assert guard.step(4, True) == 1.0
+        assert guard.step(5, True) is None  # 3rd consecutive > budget of 2
+        assert monitor.get("resilience.trips.scaler_stuck") >= 1
+
+    def test_sigterm_emergency_save_in_process(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          exit_on_preempt=False)
+        guard.step(0)
+        before = monitor.get("resilience.emergency_saves")
+        guard.install_preemption_hook()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)  # delivered synchronously
+        finally:
+            guard.uninstall_preemption_hook()
+        assert monitor.get("resilience.emergency_saves") - before == 1
+        step, path = cm.latest_valid()
+        assert step == 0
+        with open(os.path.join(path, "extra_state.json")) as f:
+            extra = json.load(f)
+        assert extra["extras"]["preempt_signal"] == int(signal.SIGTERM)
+
+    def test_preempt_exit_raises_preempted(self, tmp_path):
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          exit_on_preempt=True)
+        guard.step(0)
+        guard.install_preemption_hook()
+        try:
+            faults.inject("guard.preempt", action="sigterm", times=1)
+            with pytest.raises(Preempted):
+                guard.step(1)
+        finally:
+            guard.uninstall_preemption_hook()
+        assert cm.latest_valid()[0] == 0  # emergency checkpoint landed
+
+
+# ---------------------------------------------------------------------------
+# elastic reap + profiler section
+# ---------------------------------------------------------------------------
+class TestElasticReap:
+    def test_reap_stale_deregisters_without_report_dead(self, tmp_path):
+        import time as _time
+
+        from paddle_tpu.distributed.elastic import (ElasticManager,
+                                                    MembershipStore)
+
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=1000)
+        mgr = ElasticManager(st, min_nodes=1, max_nodes=8)
+        mgr.register("a")
+        mgr.register("b")
+        now = _time.time()
+        st.heartbeat("a")  # a is fresh; b's registration time is also fresh
+        before = monitor.get("elastic.reaped")
+        # sweep with an injected 'now' far in the future: both are stale
+        reaped = mgr.reap_stale(timeout_s=50, now=now + 100)
+        assert reaped == ["a", "b"]
+        assert monitor.get("elastic.reaped") - before == 2
+        assert st.alive() == {}
+        assert mgr.reap_stale(timeout_s=50, now=now + 100) == []
+
+
+class TestProfilerSection:
+    def test_resilience_section_rendered(self, tmp_path):
+        from paddle_tpu import profiler
+
+        cm = make_manager(tmp_path)
+        prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+        prof.start()
+        cm.save(0, state_dict=small_state())
+        prof.stop()
+        text = prof.summary()
+        assert "Resilience:" in text
+        assert "checkpoint saves" in text
+
+
+# ---------------------------------------------------------------------------
+# review regressions: donation-safe snapshots, preemption edges, metadata
+# cross-check
+# ---------------------------------------------------------------------------
+class TestReviewRegressions:
+    def test_async_save_survives_donated_buffers(self, tmp_path):
+        # the fused optimizer step donates the previous param/moment
+        # buffers; an async save that defers the device->host copy to its
+        # writer thread would read deleted arrays ("Array has been
+        # deleted") — the snapshot must happen on the caller's thread
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path, async_save=True)
+        cm.save(0, model=m, optimizer=opt)
+        for i in range(3):   # donate the buffers the writer might hold
+            step_fn(i)
+        cm.wait()            # would raise AsyncSaveError before the fix
+        step, path = cm.latest_valid()
+        assert step == 0
+        cm.load(path, model=m, optimizer=opt)
+
+    def test_load_joins_pending_async_save(self, tmp_path):
+        m, opt, _ = tiny_training()
+        cm = make_manager(tmp_path, async_save=True)
+        path = cm.save(0, model=m, optimizer=opt)
+        # load of the just-returned path must join the background writer
+        # instead of racing it (extra_state.json may not exist yet)
+        res = cm.load(path, model=m, optimizer=opt)
+        assert res.step == 0
+
+    def test_negative_loss_never_trips_spike_guard(self, tmp_path):
+        # multiplicative spike thresholds are meaningless on a negative
+        # baseline (ELBO/log-likelihood objectives): median -5, thresh 10
+        # would make EVERY healthy step "exceed" -50
+        cm = make_manager(tmp_path)
+        losses = iter([-5.0, -5.1, -4.9, -5.0, -4.8, -4.95, -5.05, -4.7])
+        guard = StepGuard(lambda i: next(losses), cm, window=2,
+                          threshold=10.0)
+        for i in range(8):
+            assert guard.step(i) is not None, f"spike trip at step {i}"
+
+    def test_sigterm_mid_step_defers_to_step_boundary(self, tmp_path):
+        # a signal inside step_fn must not checkpoint mid-step state (the
+        # optimizer may already have stepped while last_step lags one
+        # behind — resume would replay an applied update); it fires at the
+        # step boundary, after the in-flight step completes and is counted
+        m, opt, inner = tiny_training()
+        cm = make_manager(tmp_path)
+
+        def step_fn(i):
+            loss = inner(i)
+            os.kill(os.getpid(), signal.SIGTERM)  # lands inside the step
+            return loss
+
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          exit_on_preempt=True)
+        guard.install_preemption_hook()
+        try:
+            with pytest.raises(Preempted):
+                guard.step(0)
+        finally:
+            guard.uninstall_preemption_hook()
+        step, _ = cm.latest_valid()
+        assert step == 0          # the completed step, not "-1 clamped"
+        assert guard.last_step == 0
+
+    def test_preempt_before_any_step_saves_nothing(self, tmp_path):
+        # emergency-saving untrained params as "step 0" would make the
+        # resume skip step 0's training silently; with nothing completed
+        # there is nothing worth checkpointing
+        m, opt, step_fn = tiny_training()
+        cm = make_manager(tmp_path)
+        guard = StepGuard(step_fn, cm, model=m, optimizer=opt,
+                          exit_on_preempt=False)
+        before = monitor.get("resilience.emergency_saves")
+        guard.install_preemption_hook()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        finally:
+            guard.uninstall_preemption_hook()
+        assert monitor.get("resilience.emergency_saves") == before
+        assert cm.latest_valid() is None
+
+    def test_verify_checkpoint_rejects_missing_storage_entry(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                                       verify_checkpoint)
+
+        path = str(tmp_path / "ck")
+        save_state_dict(small_state(), path)
+        meta_path = os.path.join(path, "0.metadata")
+        with open(meta_path) as f:
+            raw = json.load(f)
+        raw["storage_metadata"].popitem()  # tensor index entry, no storage
+        with open(meta_path, "w") as f:
+            json.dump(raw, f)
+        with pytest.raises(CheckpointCorrupt) as ei:
+            verify_checkpoint(path)
+        assert "no shard file recorded" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# crash-kill/resume integration (subprocess driver; slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_crash_kill_resume_end_to_end():
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "crash_resume_smoke.py")
+    r = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] and out["rollbacks"] == 0 and out["quarantined"] == 1
