@@ -103,15 +103,32 @@ class BlockCacheManager:
 
     def append_token(self, seq_id: int) -> None:
         """Account one generated token; grows the table on block boundary."""
-        n = self._lens[seq_id] + 1
+        self.append_tokens(seq_id, 1)
+
+    def append_tokens(self, seq_id: int, n: int) -> None:
+        """Account `n` new tokens at once (the speculative-decode grow path:
+        one pending token + K draft tokens per step), growing the block
+        table across as many block boundaries as needed.
+
+        All-or-nothing: on `SequenceTooLong`/`KVCacheExhausted` neither the
+        length nor the table changes, so the caller can retry with a
+        smaller `n` (fewer drafts) or preempt — the same contract
+        `append_token` always had. Rollback of a *successful* append (e.g.
+        rejected speculations) is `trim(seq_id, old_len)`."""
+        if n < 0:
+            raise ValueError(f"append_tokens: n must be >= 0, got {n}")
+        new_len = self._lens[seq_id] + n
         table = self._tables[seq_id]
-        if n > len(table) * self.block_size:
-            if len(table) >= self.max_blocks_per_seq:
-                raise SequenceTooLong(len(table) + 1, self.max_blocks_per_seq)
-            if not self._free:
-                raise KVCacheExhausted(1, 0, self.num_blocks)
-            table.append(self._free.pop())
-        self._lens[seq_id] = n
+        need = self.blocks_needed(new_len) - len(table)
+        if need > 0:
+            if len(table) + need > self.max_blocks_per_seq:
+                raise SequenceTooLong(len(table) + need,
+                                      self.max_blocks_per_seq)
+            if need > len(self._free):
+                raise KVCacheExhausted(need, len(self._free), self.num_blocks)
+            for _ in range(need):
+                table.append(self._free.pop())
+        self._lens[seq_id] = new_len
 
     def trim(self, seq_id: int, num_tokens: int) -> None:
         """Shrink a sequence to `num_tokens` tokens, returning surplus
@@ -135,9 +152,14 @@ class BlockCacheManager:
     def seq_len(self, seq_id: int) -> int:
         return self._lens[seq_id]
 
-    def block_table_array(self, seq_ids) -> np.ndarray:
-        """Dense [len(seq_ids), max_blocks_per_seq] int32 table (pad 0)."""
-        out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
+    def block_table_array(self, seq_ids, pad: int = 0) -> np.ndarray:
+        """Dense [len(seq_ids), max_blocks_per_seq] int32 table.
+
+        `pad` fills entries past each sequence's allocation (default 0).
+        The speculative verify pass pads with the scheduler's guard block
+        so fixed-shape writes past a short lane's allocation land in a
+        sacrificial block instead of physical block 0."""
+        out = np.full((len(seq_ids), self.max_blocks_per_seq), pad, np.int32)
         for i, sid in enumerate(seq_ids):
             t = self._tables[sid]
             out[i, :len(t)] = t

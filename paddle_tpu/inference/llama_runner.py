@@ -171,6 +171,8 @@ class LlamaInferenceEngine:
             _prefill_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
         self._decode = jax.jit(functools.partial(
             _decode_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
+        self._verify = jax.jit(functools.partial(
+            _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
 
     # ---- public API (the serving EngineCore surface) ----
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
@@ -204,6 +206,27 @@ class LlamaInferenceEngine:
         import jax.numpy as jnp
 
         logits, self.k_cache, self.v_cache = self._decode(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32))
+        return logits
+
+    def verify_step(self, tokens: np.ndarray, context_lens: np.ndarray,
+                    block_tables: np.ndarray):
+        """Batched multi-token verify pass (speculative decoding).
+
+        tokens [B, S] int32 — per row, the pending last committed token
+        followed by S-1 draft tokens; `context_lens` [B] counts the cache
+        INCLUDING all S of them, so token i is written at position
+        `context_lens - S + i` and attends causally up to itself (same
+        fixed shape every step: zero recompiles once traced). Returns
+        logits [B, S, V]: row i is the distribution for the token AFTER
+        tokens[:, i] — rows 0..S-2 verify the drafts, row S-1 samples the
+        bonus token when every draft is accepted."""
+        import jax.numpy as jnp
+
+        logits, self.k_cache, self.v_cache = self._verify(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(context_lens, jnp.int32),
@@ -291,8 +314,12 @@ class _StaticCfg:
         return self.__dict__ == o.__dict__
 
 
-def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, decode):
-    """One decoder layer on [B, S, H]; returns (x, (new_k_blocks, new_v_blocks))."""
+def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode):
+    """One decoder layer on [B, S, H]; returns (x, (new_k_blocks, new_v_blocks)).
+
+    `mode`: "prefill" (dense causal SDPA over the in-flight tokens),
+    "decode" (single-query paged attention), or "verify" (S-query causal
+    paged attention — the speculative multi-token verify pass)."""
     import jax
     import jax.numpy as jnp
 
@@ -316,12 +343,18 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, decode):
     start = positions[:, 0].astype(jnp.int32)
     kc, vc = pk.write_kv_to_cache(k, v, kc, vc, tables, start)
 
-    if decode:
+    if mode == "decode":
         qd = q.reshape(b, nh, d)
         if pk.supported((b, nh, d), qd.dtype):
             attn = pk.paged_attention(qd, kc, vc, tables, ctx_lens)
         else:
             attn = pk.paged_attention_ref(qd, kc, vc, tables, ctx_lens)
+        attn = attn.reshape(b, s, nh * d)
+    elif mode == "verify":
+        if pk.verify_supported((b, s, nh, d), q.dtype):
+            attn = pk.paged_attention_verify(q, kc, vc, tables, ctx_lens)
+        else:
+            attn = pk.paged_attention_verify_ref(q, kc, vc, tables, ctx_lens)
         attn = attn.reshape(b, s, nh * d)
     else:
         kk, vv = k, v
@@ -343,7 +376,7 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, decode):
 
 
 def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
-               cfg, decode):
+               cfg, mode):
     import jax
     import jax.numpy as jnp
 
@@ -354,7 +387,7 @@ def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
         x, (kc, vc) = _layer_body(
             x, (ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc, cos, sin),
             cfg=cfg, positions=positions, tables=tables, ctx_lens=ctx_lens,
-            decode=decode)
+            mode=mode)
         return x, (kc, vc)
 
     xs = (params["ln1"], params["qkv_w"], params["o_w"], params["ln2"],
@@ -383,7 +416,7 @@ def _prefill_fn(params, k_cache, v_cache, input_ids, tables, lens, *, cfg):
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     ctx = jnp.full((b,), s, jnp.int32)
     logits, nk, nv = _run_stack(params, k_cache, v_cache, x, positions,
-                                tables, ctx, cfg, decode=False)
+                                tables, ctx, cfg, mode="prefill")
     idx = jnp.clip(lens - 1, 0, s - 1)
     last = jnp.take_along_axis(
         logits, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :]
@@ -401,5 +434,22 @@ def _decode_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
     positions = (ctx_lens - 1)[:, None].astype(jnp.int32)   # [B, 1]
     logits, nk, nv = _run_stack(params, k_cache, v_cache, x, positions,
                                 tables, ctx_lens.astype(jnp.int32), cfg,
-                                decode=True)
+                                mode="decode")
     return logits[:, -1, :].astype(jnp.float32), nk, nv
+
+
+def _verify_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.verify_retraces")  # trace-time only (see prefill)
+    b, s = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)            # [B, S, H]
+    positions = jnp.maximum(
+        ctx_lens[:, None] - s + jnp.arange(s, dtype=jnp.int32)[None, :],
+        0).astype(jnp.int32)                                 # [B, S]
+    logits, nk, nv = _run_stack(params, k_cache, v_cache, x, positions,
+                                tables, ctx_lens.astype(jnp.int32), cfg,
+                                mode="verify")
+    return logits.astype(jnp.float32), nk, nv                # [B, S, V]

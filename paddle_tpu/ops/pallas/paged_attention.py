@@ -143,6 +143,165 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     return out[:, :, :g, :].reshape(batch, h, d)
 
 
+def _verify_kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, sm_scale, block_size,
+                   num_queries, g_pad):
+    """Multi-query causal decode kernel (speculative-decode verify pass).
+
+    Same online-softmax structure as `_decode_kernel`, but the q block holds
+    S query tokens × G head-group rows: row r is query s = r // g_pad, whose
+    absolute position is ctx_len - S + s, so its causal limit is
+    `pos <= ctx_len - S + s` — one extra iota against the same score tile.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx_len = lens_ref[b]
+
+    @pl.when(j * block_size < ctx_len)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (S*G, D)
+        k = k_ref[0, 0].astype(jnp.float32)                 # (BS, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        # typed scalars: python ints weak-type to i64 when the interpret-
+        # mode kernel is traced inside an x64-on outer program (see the
+        # NEG_INF note in _decode_kernel)
+        qpos = (ctx_len - jnp.int32(num_queries)
+                + row // jnp.int32(g_pad))                  # per-row limit
+        s = jnp.where(pos <= qpos, s, jnp.float32(NEG_INF))
+        m_prev = m_ref[...][:, 0]
+        l_prev = l_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _verify_call(q, k_cache, v_cache, block_tables, context_lens, sm_scale,
+                 num_queries, g_pad):
+    """q: [B, KV_H, S*Gp, D]; caches: [KV_H, NB, BS, D]."""
+    batch, kv_h, rows, d = q.shape
+    block_size = k_cache.shape[2]
+    max_blocks = block_tables.shape[1]
+
+    kern = functools.partial(_verify_kernel, sm_scale=sm_scale,
+                             block_size=block_size, num_queries=num_queries,
+                             g_pad=g_pad)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, kv_h, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d),
+                         lambda b, h, j, lens, tables: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda b, h, j, lens, tables: (h, tables[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda b, h, j, lens, tables: (h, tables[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d),
+                               lambda b, h, j, lens, tables: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    return _support.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, kv_h, rows, d), q.dtype),
+        interpret=_support.interpret_mode(),
+    )(context_lens, block_tables, q, k_cache, v_cache)
+
+
+def paged_attention_verify(q, k_cache, v_cache, block_tables, context_lens,
+                           sm_scale=None):
+    """Batched multi-token verify attention over the paged KV cache.
+
+    The speculative-decode verify pass: S tokens per sequence (the pending
+    token + K drafts) attend causally against the paged cache, whose last S
+    positions are the tokens themselves (already written via
+    `write_kv_to_cache`).
+
+    Args:
+      q: [B, S, H, D] — query token i of row b sits at absolute position
+         context_lens[b] - S + i and attends to positions <= its own.
+      k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim].
+      block_tables: [B, max_blocks_per_seq] int32 physical block ids.
+      context_lens: [B] int32 — tokens in cache INCLUDING all S new ones.
+    Returns [B, S, H, D].
+    """
+    batch, s, h, d = q.shape
+    kv_h = k_cache.shape[1]
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    # [B, S, H, D] -> [B, KV_H, S*Gp, D]: group queries by kv head, pad the
+    # group dim so each query's row band is sublane-aligned and the kernel
+    # can recover the query index as row // g_pad.
+    g_pad = g if g % 8 == 0 else (g // 8 + 1) * 8
+    qg = jnp.swapaxes(q.reshape(batch, s, kv_h, g, d), 1, 2)  # [B,KVH,S,G,D]
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+    qg = qg.reshape(batch, kv_h, s * g_pad, d)
+    kc = jnp.swapaxes(k_cache, 0, 1)  # [KV_H, NB, BS, D]
+    vc = jnp.swapaxes(v_cache, 0, 1)
+    out = _verify_call(qg, kc, vc, block_tables.astype(jnp.int32),
+                       context_lens.astype(jnp.int32), float(sm_scale),
+                       s, g_pad)
+    out = out.reshape(batch, kv_h, s, g_pad, d)[:, :, :, :g, :]
+    return jnp.swapaxes(out, 1, 2).reshape(batch, s, h, d)
+
+
+def paged_attention_verify_ref(q, k_cache, v_cache, block_tables,
+                               context_lens, sm_scale=None):
+    """XLA reference for the verify pass (also the CPU fallback)."""
+    batch, s, h, d = q.shape
+    nb, kv_h, bs, _ = k_cache.shape
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    k = jnp.take(k_cache, block_tables, axis=0)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    max_s = block_tables.shape[1] * bs
+    k = jnp.swapaxes(k, 2, 3).reshape(batch, max_s, kv_h, d)
+    v = jnp.swapaxes(v, 2, 3).reshape(batch, max_s, kv_h, d)
+    qg = jnp.swapaxes(q.reshape(batch, s, kv_h, g, d), 1, 2)  # [B,KVH,S,G,D]
+    sc = jnp.einsum("bhqgd,bshd->bhqgs", qg.astype(jnp.float32),
+                    k.astype(jnp.float32),
+                    preferred_element_type=jnp.float32) * sm_scale
+    wpos = jnp.arange(max_s, dtype=jnp.int32)
+    qpos = (context_lens[:, None] - s
+            + jnp.arange(s, dtype=jnp.int32)[None, :])       # [B, S]
+    mask = wpos[None, None, :] <= qpos[:, :, None]           # [B, S, W]
+    sc = jnp.where(mask[:, None, :, None, :], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhqgs,bshd->bhqgd", p, v.astype(jnp.float32))
+    return jnp.swapaxes(out, 1, 2).reshape(batch, s, h, d).astype(q.dtype)
+
+
 def paged_attention_ref(q, k_cache, v_cache, block_tables, context_lens,
                         sm_scale=None):
     """XLA reference path (gather + masked softmax); also the CPU fallback."""
@@ -197,5 +356,18 @@ def supported(q_shape, dtype) -> bool:
     if len(q_shape) != 3:
         return False
     if q_shape[-1] > 256:
+        return False
+    return str(np.dtype(dtype)) in ("float32", "bfloat16", "float16")
+
+
+def verify_supported(q_shape, dtype) -> bool:
+    """Gate for `paged_attention_verify` (q: [B, S, H, D])."""
+    if not _support.kernels_enabled():
+        return False
+    if len(q_shape) != 4:
+        return False
+    if q_shape[-1] > 256:
+        return False
+    if q_shape[1] > 64:          # S*Gp rows must stay a small VMEM tile
         return False
     return str(np.dtype(dtype)) in ("float32", "bfloat16", "float16")

@@ -466,6 +466,15 @@ class Profiler:
                 f"  TTFT p50 {g('serving.ttft_p50_ms')} ms / "
                 f"p99 {g('serving.ttft_p99_ms')} ms, "
                 f"TPOT mean {g('serving.tpot_mean_ms')} ms")
+        if g("serving.spec_steps"):
+            lines.append(
+                f"  speculative: {g('serving.spec_accepted_tokens')}/"
+                f"{g('serving.spec_proposed_tokens')} drafts accepted "
+                f"({g('serving.spec_acceptance_pct')}%) over "
+                f"{g('serving.spec_steps')} verify rounds, "
+                f"{g('serving.spec_tokens_per_lane_step')} tok/lane-step "
+                f"(verify retraces {g('serving.verify_retraces')}, "
+                f"sample retraces {g('serving.sample_retraces')})")
         if rejected:
             lines.append("  reject reasons: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(rejected.items())))

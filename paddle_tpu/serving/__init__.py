@@ -28,9 +28,12 @@ from .engine import EngineCore, MLPLMEngine
 from .frontend import RequestHandle, ServingFrontend
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
+from .spec import (DraftEngineProposer, NGramProposer, Proposer,
+                   SpecDecodeConfig)
 
 __all__ = [
-    "EngineCore", "MLPLMEngine", "Request", "RequestHandle",
-    "RequestStatus", "SamplingParams", "Scheduler", "ServingFrontend",
-    "ServingMetrics",
+    "DraftEngineProposer", "EngineCore", "MLPLMEngine", "NGramProposer",
+    "Proposer", "Request", "RequestHandle", "RequestStatus",
+    "SamplingParams", "Scheduler", "ServingFrontend", "ServingMetrics",
+    "SpecDecodeConfig",
 ]

@@ -51,6 +51,15 @@ class EngineCore(Protocol):
                     block_tables: np.ndarray) -> np.ndarray:
         ...
 
+    def verify_step(self, tokens: np.ndarray, context_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        """Batched multi-token verify (speculative decoding): tokens
+        [B, S] (pending last token + S-1 drafts), `context_lens` counting
+        the cache INCLUDING all S tokens, returns logits [B, S, V] where
+        row i is the distribution after tokens[:, i]. Fixed S every call
+        so the steady state never recompiles."""
+        ...
+
 
 def _mlp_prefill(params, cache, input_ids, tables, lens, *, block_size):
     import jax.numpy as jnp
@@ -95,6 +104,33 @@ def _mlp_decode(params, cache, tokens, ctx_lens, tables, *, block_size):
     mask = (wpos[None, :] < ctx_lens[:, None]).astype(x.dtype)
     mean = (window * mask[..., None]).sum(1) / jnp.maximum(
         mask.sum(1, keepdims=True), 1.0)
+    logits = _mlp_head(params, x, mean)
+    return logits.astype(jnp.float32), cache
+
+
+def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.verify_retraces")  # trace-time only
+    b, s = tokens.shape
+    maxb = tables.shape[1]
+    x = jnp.take(params["embed"], tokens, axis=0)            # [B, S, D]
+    pos = jnp.maximum(
+        ctx_lens[:, None] - s + jnp.arange(s, dtype=jnp.int32)[None, :],
+        0)                                                   # [B, S]
+    blocks = jnp.take_along_axis(tables, pos // block_size, axis=1)
+    cache = cache.at[blocks.reshape(-1), (pos % block_size).reshape(-1)].set(
+        x.reshape(b * s, -1))
+    window = jnp.take(cache, tables.reshape(-1), axis=0).reshape(
+        b, maxb * block_size, -1)                            # [B, W, D]
+    wpos = jnp.arange(maxb * block_size, dtype=jnp.int32)
+    # query i conditions on positions <= its own (same mask decode_step
+    # applies with ctx_lens = pos + 1), per verify row
+    mask = (wpos[None, None, :] <= pos[:, :, None]).astype(x.dtype)
+    mean = (window[:, None] * mask[..., None]).sum(2) / jnp.maximum(
+        mask.sum(2, keepdims=True), 1.0)                     # [B, S, D]
     logits = _mlp_head(params, x, mean)
     return logits.astype(jnp.float32), cache
 
@@ -148,6 +184,9 @@ class MLPLMEngine:
         self._decode = jax.jit(
             functools.partial(_mlp_decode, block_size=block_size),
             donate_argnums=(1,))
+        self._verify = jax.jit(
+            functools.partial(_mlp_verify, block_size=block_size),
+            donate_argnums=(1,))
 
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
                 lens: Optional[np.ndarray] = None) -> np.ndarray:
@@ -168,6 +207,20 @@ class MLPLMEngine:
         import jax.numpy as jnp
 
         logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32))
+        return logits
+
+    def verify_step(self, tokens: np.ndarray, context_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        """Multi-token verify pass; see `EngineCore.verify_step`. Token i
+        of row b lands at position context_lens[b] - S + i and conditions
+        on (its own embedding, masked mean through its position) — exactly
+        what a sequence of S `decode_step` calls would compute."""
+        import jax.numpy as jnp
+
+        logits, self.cache = self._verify(
             self.params, self.cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(context_lens, jnp.int32),
             jnp.asarray(block_tables, jnp.int32))

@@ -73,10 +73,14 @@ class RequestHandle:
 class ServingFrontend:
     def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
                  max_queue: int = 256,
-                 default_timeout_s: Optional[float] = None):
+                 default_timeout_s: Optional[float] = None,
+                 spec=None):
+        """`spec`: optional `SpecDecodeConfig` enabling speculative
+        decoding (proposer + fixed draft length K) for every request
+        served through this frontend."""
         self.metrics = metrics or ServingMetrics()
         self.scheduler = Scheduler(engine, metrics=self.metrics,
-                                   max_queue=max_queue)
+                                   max_queue=max_queue, spec=spec)
         self.default_timeout_s = default_timeout_s
 
     # ---- request API ----

@@ -45,18 +45,27 @@ class ServingMetrics:
     def __init__(self):
         self.ttft_s = deque(maxlen=_WINDOW)
         self.tpot_s = deque(maxlen=_WINDOW)
+        # per-step acceptance-rate samples (speculative decoding) — same
+        # bounded-window contract as the latency deques: a long-running
+        # server must never grow a sample list
+        self.accept_rate = deque(maxlen=_WINDOW)
         self._occ_sum = 0.0
         self._steps = 0
         self._finishes = 0
+        self._spec_steps = 0
+        self._spec_produced = 0
 
     def reset_window(self):
         """Drop latency samples and the occupancy accumulator (e.g. at a
         warmup/measurement boundary) without touching monitor counters."""
         self.ttft_s.clear()
         self.tpot_s.clear()
+        self.accept_rate.clear()
         self._occ_sum = 0.0
         self._steps = 0
         self._finishes = 0
+        self._spec_steps = 0
+        self._spec_produced = 0
 
     # ---- request lifecycle ----
     def on_submit(self):
@@ -100,6 +109,30 @@ class ServingMetrics:
     def on_decode(self, tokens: int):
         monitor.inc("serving.decode_steps")
         monitor.inc("serving.tokens_generated", tokens)
+
+    def on_spec(self, proposed: int, accepted: int, produced: int,
+                lanes: int):
+        """One speculative verify round: `proposed` draft tokens offered,
+        `accepted` matched the target, `produced` tokens committed
+        (accepted + one bonus/correction per lane) across `lanes` decoded
+        lanes. `spec_tokens_per_lane_step` is the speculative speedup
+        estimate: a non-speculative decode commits exactly 1 token per
+        lane per step."""
+        monitor.inc("serving.spec_steps")
+        monitor.inc("serving.spec_proposed_tokens", proposed)
+        monitor.inc("serving.spec_accepted_tokens", accepted)
+        self._spec_steps += max(lanes, 1)
+        self._spec_produced += produced
+        if proposed:
+            self.accept_rate.append(accepted / proposed)
+        tot_p = monitor.get("serving.spec_proposed_tokens")
+        tot_a = monitor.get("serving.spec_accepted_tokens")
+        if tot_p:
+            monitor.set_value("serving.spec_acceptance_pct",
+                              round(tot_a / tot_p * 100.0, 1))
+        monitor.set_value(
+            "serving.spec_tokens_per_lane_step",
+            round(self._spec_produced / max(self._spec_steps, 1), 2))
 
     def on_step(self, occupancy: float, kv_utilization: float,
                 queue_depth: int, decoded: bool = True):

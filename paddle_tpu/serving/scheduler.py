@@ -21,6 +21,16 @@ Policy (documented in docs/SERVING.md):
   immediately; the slot admits a new request on the same step.
 - padding: empty slots decode with ctx_len=1 against a dedicated guard
   block (never a sequence's block), so padded lanes can't corrupt live KV.
+- speculative decoding (optional, `SpecDecodeConfig`): each round a
+  proposer drafts up to K tokens per lane; ONE fixed-shape
+  `engine.verify_step` scores all lanes' pending+draft tokens at once;
+  the accepted prefix plus a bonus/correction token commit, and rejected
+  speculation rolls back via `BlockCacheManager.trim`. Greedy speculative
+  output is token-for-token identical to plain decode.
+
+Sampling (both paths) is the device-side fused batched sampler
+(`ops/sampling.py`): temperature/top-k/Gumbel-max under one jit with a
+per-request counter-based RNG — no per-lane host numpy in the loop.
 """
 from __future__ import annotations
 
@@ -33,8 +43,10 @@ from typing import Callable, Deque, List, Optional
 import numpy as np
 
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
+from ..ops.sampling import sample_tokens
 from .engine import EngineCore
 from .metrics import ServingMetrics
+from .spec import SpecDecodeConfig
 
 __all__ = ["SamplingParams", "RequestStatus", "Request", "Scheduler"]
 
@@ -91,7 +103,6 @@ class Request:
         self.t_finish: Optional[float] = None
         self._last: Optional[int] = None      # sampled, KV not yet written
         self._admit_seq = -1                  # admission order (victim pick)
-        self._rng = np.random.default_rng(self.sampling.seed + self.req_id)
 
     @property
     def seq_id(self) -> int:
@@ -104,6 +115,13 @@ class Request:
         gen = self.generated[:-1] if self._last is not None else self.generated
         return np.concatenate([self.prompt,
                                np.asarray(gen, np.int32)]).astype(np.int32)
+
+    def all_tokens(self) -> np.ndarray:
+        """Prompt + every generated token INCLUDING the pending last one —
+        the stream a speculative proposer continues from."""
+        return np.concatenate([
+            self.prompt, np.asarray(self.generated, np.int32)]).astype(
+                np.int32)
 
     def ttft(self) -> Optional[float]:
         if self.t_first_token is None or self.t_submit is None:
@@ -123,10 +141,12 @@ class Scheduler:
 
     def __init__(self, engine: EngineCore,
                  metrics: Optional[ServingMetrics] = None,
-                 max_queue: int = 256):
+                 max_queue: int = 256,
+                 spec: Optional[SpecDecodeConfig] = None):
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
         self.max_queue = max_queue
+        self.spec = spec
         self.slots: List[Optional[Request]] = [None] * engine.max_batch_size
         self.waiting: Deque[Request] = deque()
         self._admit_counter = itertools.count()
@@ -277,7 +297,8 @@ class Scheduler:
             req._admit_seq = next(self._admit_counter)
             self.slots[slot] = req
             if not was_preempted:
-                tok = self._sample(np.asarray(logits)[0], req)
+                tok = int(sample_tokens(logits, *self._sampling_arrays(
+                    [req]))[0])
                 req.generated.append(tok)
                 req._last = tok
                 if req.t_first_token is None:
@@ -290,24 +311,38 @@ class Scheduler:
             # prefill logits above are for a token already sampled — drop.
         self.metrics.gauge_queue(len(self.waiting))
 
+    @staticmethod
+    def _sampling_arrays(reqs):
+        """Per-lane (temperature, top_k, seed, draw_idx) vectors for the
+        fused device sampler; `None` entries (padded lanes) sample greedy
+        with dummy params. `draw_idx` is tokens drawn so far, so draws are
+        reproducible across preemption and batch-slot churn. The seed is
+        the request's own (same seed + same prompt -> same stream, across
+        runs and speculative/plain paths alike — nothing process-global
+        enters the key)."""
+        temps = np.asarray([0.0 if r is None else r.sampling.temperature
+                            for r in reqs], np.float32)
+        # mask user-supplied ints to 31 bits: numpy >= 2.0 raises
+        # OverflowError on out-of-range int32 construction, and a caller
+        # passing seed=2**31 must not crash the whole decode step (the
+        # mask is deterministic, so reproducibility is preserved)
+        topks = np.asarray([0 if r is None else
+                            int(r.sampling.top_k) & 0x7FFFFFFF
+                            for r in reqs], np.int32)
+        seeds = np.asarray([0 if r is None else
+                            int(r.sampling.seed) & 0x7FFFFFFF
+                            for r in reqs], np.int32)
+        draws = np.asarray([0 if r is None else len(r.generated)
+                            for r in reqs], np.int32)
+        return temps, topks, seeds, draws
+
     def _grow(self, req: Request, slot: int) -> bool:
         """Account the pending token's cache slot; preempt on exhaustion.
-        Returns False if the request left the batch instead."""
-        mgr = self.engine.manager
-        while True:
-            try:
-                mgr.append_token(req.seq_id)
-                return True
-            except SequenceTooLong:
-                self._finish(req, RequestStatus.FINISHED, "length_cap",
-                             slot=slot)
-                return False
-            except KVCacheExhausted:
-                if not self._preempt_one(exclude=req):
-                    # nothing left to steal from: the pool is truly full
-                    self._finish(req, RequestStatus.FINISHED, "kv_capacity",
-                                 slot=slot)
-                    return False
+        Returns False if the request left the batch instead. One policy,
+        two entry points: this is `_grow_n` with a single-token request,
+        so the length_cap/kv_capacity/preemption ladder cannot diverge
+        between the plain and speculative decode paths."""
+        return self._grow_n(req, slot, 1) == 1
 
     def _preempt_one(self, exclude: Request) -> bool:
         """Evict the most-recently-admitted running request (≠ exclude)
@@ -319,6 +354,7 @@ class Scheduler:
         _, slot = max(victims)
         req = self.slots[slot]
         self.engine.manager.free(req.seq_id)
+        self._release_spec(req)
         self.slots[slot] = None
         req.status = RequestStatus.PREEMPTED
         req.num_preemptions += 1
@@ -328,6 +364,8 @@ class Scheduler:
         return True
 
     def _decode(self, now: float) -> int:
+        if self.spec is not None:
+            return self._decode_spec(now)
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return 0
@@ -352,11 +390,16 @@ class Scheduler:
         from ..profiler import RecordEvent
 
         with RecordEvent("serving.decode_step"):
-            logits = np.asarray(self.engine.decode_step(tokens, lens, tables))
+            logits = self.engine.decode_step(tokens, lens, tables)
         t_tok = time.perf_counter()
+        # fused device sampling over ALL lanes (fixed [B, V] shape; padded
+        # lanes sample greedy and are discarded)
+        active_map = dict(active)
+        picked = sample_tokens(logits, *self._sampling_arrays(
+            [active_map.get(i) for i in range(B)]))
         produced = 0
         for i, req in active:
-            tok = self._sample(logits[i], req)
+            tok = int(picked[i])
             req.generated.append(tok)
             req._last = tok
             produced += 1
@@ -367,6 +410,130 @@ class Scheduler:
                 req.stream_cb(req, tok)
             self._maybe_finish_on_token(req, tok, i)
         self.metrics.on_decode(produced)
+        return produced
+
+    # ---- speculative decoding ----
+    def _grow_n(self, req: Request, slot: int, want: int) -> int:
+        """Reserve cache slots for the pending token plus `want - 1` draft
+        tokens. Degrades before it preempts: on pressure the drafts are
+        dropped first (want -> 1, plain decode growth), THEN the normal
+        preempt/finish policy applies. Returns slots reserved (0 if the
+        request left the batch)."""
+        mgr = self.engine.manager
+        while True:
+            try:
+                mgr.append_tokens(req.seq_id, want)
+                return want
+            except SequenceTooLong:
+                cap = mgr.max_blocks_per_seq * mgr.block_size \
+                    - mgr.seq_len(req.seq_id)
+                if cap >= 1:
+                    want = min(want, cap)
+                    continue
+                self._finish(req, RequestStatus.FINISHED, "length_cap",
+                             slot=slot)
+                return 0
+            except KVCacheExhausted:
+                if want > 1:
+                    want = 1
+                    continue
+                if not self._preempt_one(exclude=req):
+                    self._finish(req, RequestStatus.FINISHED, "kv_capacity",
+                                 slot=slot)
+                    return 0
+
+    def _decode_spec(self, now: float) -> int:
+        """One speculative round: propose -> ONE fixed-shape verify over
+        all lanes -> fused sampling -> accept longest matching draft
+        prefix + bonus token -> `trim` rollback of rejected slots.
+
+        Shape discipline: the verify batch is always [B, K+1] tokens.
+        Lanes with fewer than K drafts reserve only what they hold; the
+        surplus fixed-shape KV writes land in guard-padded block-table
+        entries, never in live blocks."""
+        active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return 0
+        mgr = self.engine.manager
+        K = self.spec.num_draft_tokens
+        S = K + 1
+        proposer = self.spec.proposer
+        lanes = []                   # (slot, req, drafts, pre_len)
+        for i, req in active:
+            if self.slots[i] is not req:
+                continue
+            pre_len = mgr.seq_len(req.seq_id)
+            try:
+                drafts = list(proposer.propose(
+                    req.seq_id, req.all_tokens(), K))[:K]
+            except Exception:
+                drafts = []          # proposers must never kill the step
+            got = self._grow_n(req, i, 1 + len(drafts))
+            if got == 0:
+                continue
+            lanes.append((i, req, drafts[:got - 1], pre_len))
+        lanes = [(i, r, d, p) for i, r, d, p in lanes if self.slots[i] is r]
+        if not lanes:
+            return 0
+        B = len(self.slots)
+        tokens = np.zeros((B, S), np.int32)
+        ctx = np.full((B,), S, np.int32)      # pad lanes write guard block
+        # a lane within S tokens of its hard length cap has a table FULL
+        # of real blocks while ctx still counts the fixed S-token window,
+        # so the engines' block gather for positions past the cap indexes
+        # past the table width. Without the trailing guard columns the
+        # write survives only by accident (jnp OOB-gather fill int32-min,
+        # times a power-of-two block size, wraps to physical block 0 —
+        # which is the guard only because it's the first block ever
+        # leased); make the invariant explicit instead (width is a
+        # function of the fixed S: still one compiled program).
+        width = mgr.max_blocks_per_seq + (S + mgr.block_size - 2) \
+            // mgr.block_size
+        tables = np.full((B, width), self._pad_block, np.int32)
+        lane_reqs: List[Optional[Request]] = [None] * B
+        for i, req, drafts, pre_len in lanes:
+            tokens[i, 0] = req._last
+            if drafts:
+                tokens[i, 1:1 + len(drafts)] = drafts
+            # uniform layout: token j sits at position pre_len + j, so
+            # ctx counts the full fixed window even when len(drafts) < K
+            ctx[i] = pre_len + S
+            tables[i, :mgr.max_blocks_per_seq] = mgr.block_table_array(
+                [req.seq_id], pad=self._pad_block)[0]
+            lane_reqs[i] = req
+        from ..profiler import RecordEvent
+
+        with RecordEvent("serving.verify_step"):
+            logits = self.engine.verify_step(tokens, ctx, tables)
+        t_tok = time.perf_counter()
+        picked = sample_tokens(logits, *self._sampling_arrays(lane_reqs))
+        produced = proposed = accepted = 0
+        for i, req, drafts, pre_len in lanes:
+            a = 0
+            while a < len(drafts) and drafts[a] == int(picked[i, a]):
+                a += 1
+            proposed += len(drafts)
+            accepted += a
+            # emit the accepted drafts (== the sampled tokens) plus the
+            # bonus/correction token from the first unmatched position
+            for tok in (int(picked[i, j]) for j in range(a + 1)):
+                req.generated.append(tok)
+                req._last = tok
+                produced += 1
+                if req.t_first_token is None:
+                    req.t_first_token = t_tok
+                    self.metrics.on_first_token(req)
+                if req.stream_cb is not None:
+                    req.stream_cb(req, tok)
+                self._maybe_finish_on_token(req, tok, i)
+                if req.status.terminal:
+                    break
+            if not req.status.terminal:
+                # roll back rejected speculation: keep pending + accepted
+                mgr.trim(req.seq_id, pre_len + 1 + a)
+        self.metrics.on_decode(produced)
+        self.metrics.on_spec(proposed=proposed, accepted=accepted,
+                             produced=produced, lanes=len(lanes))
         return produced
 
     def _maybe_finish_on_token(self, req: Request, tok: int, slot: int):
@@ -384,19 +551,19 @@ class Scheduler:
                 slot = self.slots.index(req)
             self.slots[slot] = None
             self.engine.manager.free(req.seq_id)
+        self._release_spec(req)
         req.status = status
         req.finish_reason = reason
         req.t_finish = time.perf_counter()
         self.metrics.on_finish(req)
 
-    def _sample(self, logits: np.ndarray, req: Request) -> int:
-        sp = req.sampling
-        if sp.temperature <= 0.0:
-            return int(np.argmax(logits))
-        x = logits.astype(np.float64) / max(sp.temperature, 1e-6)
-        if sp.top_k:
-            kth = np.partition(x, -sp.top_k)[-sp.top_k]
-            x = np.where(x < kth, -np.inf, x)
-        p = np.exp(x - x.max())
-        p /= p.sum()
-        return int(req._rng.choice(len(p), p=p))
+    def _release_spec(self, req: Request):
+        """Drop any speculative-proposer state for a request leaving the
+        batch (finish, cancel, preempt). Idempotent; never raises into
+        the serving path."""
+        if self.spec is None:
+            return
+        try:
+            self.spec.proposer.release(req.seq_id)
+        except Exception:
+            pass

@@ -52,6 +52,98 @@ def test_paged_attention_mha_group1(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_paged_attention_verify_matches_per_row_decode(rng):
+    """The multi-query verify kernel == S single-query decode calls: row i
+    (absolute position ctx_len - S + i) must equal `paged_attention` with
+    the context truncated to ctx_len - S + i + 1 tokens."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    B, S, H, KVH, D, BS, NB, MAXB = 2, 4, 8, 4, 32, 16, 12, 4
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(NB)[:B * MAXB].reshape(B, MAXB),
+                         jnp.int32)
+    lens = jnp.asarray([37, 50], jnp.int32)
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens)
+    ref = pa.paged_attention_verify_ref(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    for i in range(S):
+        row = pa.paged_attention(
+            jnp.asarray(q[:, i]), kc, vc, tables, lens - (S - 1 - i))
+        np.testing.assert_allclose(np.asarray(out[:, i]), np.asarray(row),
+                                   atol=1e-5)
+
+
+def test_paged_attention_verify_mha_group1(rng):
+    """MHA (G=1) exercises the verify kernel's group-padding path."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    B, S, H, D, BS, NB, MAXB = 2, 3, 4, 16, 8, 10, 3
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(NB, H, BS, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, H, BS, D)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, NB, size=(B, MAXB)), jnp.int32)
+    lens = jnp.asarray([9, 17], jnp.int32)
+    ref = pa.paged_attention_verify_ref(q, kc, vc, tables, lens)
+    out = pa.paged_attention_verify(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_llama_verify_step_matches_sequential_decode():
+    """One fixed-shape verify over S tokens reproduces S single-token
+    decode_step calls bitwise — the greedy-parity foundation of the
+    speculative path."""
+    from paddle_tpu.inference import LlamaInferenceEngine
+    from paddle_tpu.models.llama import llama_tiny
+
+    paddle.seed(13)
+    model = llama_tiny(vocab=64, layers=2, hidden=32, heads=4, seq=64)
+    model.eval()
+
+    def build():
+        return LlamaInferenceEngine(model, max_batch_size=2, num_blocks=32,
+                                    block_size=8, max_blocks_per_seq=6)
+
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, 64, size=(2, 11)).astype(np.int32)
+    S = 4
+
+    seq = build()
+    for b in range(2):
+        seq.manager.allocate(b, 11)
+    tables = seq.manager.block_table_array([0, 1])
+    lg = np.asarray(seq.prefill(prompt, tables,
+                                lens=np.full(2, 11, np.int32)))
+    toks = [np.argmax(lg, -1).astype(np.int32)]
+    step_logits = []
+    for _ in range(S):
+        for b in range(2):
+            seq.manager.append_token(b)
+        lens = np.asarray([seq.manager.seq_len(0), seq.manager.seq_len(1)],
+                          np.int32)
+        lg = np.asarray(seq.decode_step(toks[-1], lens,
+                                        seq.manager.block_table_array([0, 1])))
+        step_logits.append(lg)
+        toks.append(np.argmax(lg, -1).astype(np.int32))
+
+    ver = build()
+    for b in range(2):
+        ver.manager.allocate(b, 11)
+    ver.prefill(prompt, ver.manager.block_table_array([0, 1]),
+                lens=np.full(2, 11, np.int32))
+    for b in range(2):
+        ver.manager.append_tokens(b, S)
+    vlg = np.asarray(ver.verify_step(
+        np.stack(toks[:S], axis=1),
+        np.asarray([ver.manager.seq_len(0), ver.manager.seq_len(1)],
+                   np.int32),
+        ver.manager.block_table_array([0, 1])))
+    assert vlg.shape == (2, S, 64)
+    for i in range(S):
+        np.testing.assert_array_equal(vlg[:, i], step_logits[i])
+
+
 def test_write_kv_then_decode_roundtrip(rng):
     """Prefill-write + decode attention == dense causal attention."""
     from paddle_tpu.ops.pallas import paged_attention as pa

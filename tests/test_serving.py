@@ -13,8 +13,10 @@ from paddle_tpu.framework import monitor
 from paddle_tpu.inference import (KVCacheExhausted, LlamaInferenceEngine,
                                   SequenceTooLong)
 from paddle_tpu.inference.cache import BlockCacheManager
-from paddle_tpu.serving import (MLPLMEngine, RequestStatus, ServingFrontend,
-                                ServingMetrics)
+from paddle_tpu.ops.sampling import sample_tokens
+from paddle_tpu.serving import (DraftEngineProposer, MLPLMEngine,
+                                NGramProposer, RequestStatus, ServingFrontend,
+                                ServingMetrics, SpecDecodeConfig)
 
 VOCAB = 64
 
@@ -49,6 +51,23 @@ def engine(request, llama_model):
         return make_mlp_engine()
     return LlamaInferenceEngine(llama_model, max_batch_size=4, num_blocks=48,
                                 block_size=4, max_blocks_per_seq=8)
+
+
+@pytest.fixture(params=["mlp", "llama"])
+def engine_factory(request, llama_model):
+    """Builds engines with IDENTICAL weights on every call (MLP params are
+    seed-deterministic; llama reuses the module-scoped model) — the
+    speculative parity tests compare a plain and a spec run over two
+    fresh engines of the same model."""
+    if request.param == "mlp":
+        return make_mlp_engine
+
+    def make():
+        return LlamaInferenceEngine(llama_model, max_batch_size=4,
+                                    num_blocks=48, block_size=4,
+                                    max_blocks_per_seq=8)
+
+    return make
 
 
 def prompts(n, rng=None, lo=2, hi=12):
@@ -88,6 +107,74 @@ class TestCacheManager:
         with pytest.raises(KVCacheExhausted):
             mgr.append_token(0)
         assert mgr.seq_len(0) == 2  # length NOT bumped by the failed append
+
+    def test_append_tokens_crosses_block_boundary(self):
+        mgr = BlockCacheManager(num_blocks=8, block_size=4,
+                                max_blocks_per_seq=8)
+        mgr.allocate(0, 3)                   # 1 block, 1 slot headroom
+        free0 = mgr.free_blocks
+        mgr.append_tokens(0, 6)              # 3 -> 9 tokens: crosses into
+        assert mgr.seq_len(0) == 9           # blocks 2 AND 3 in one call
+        assert mgr.free_blocks == free0 - 2
+        assert len(mgr._tables[0]) == 3
+        mgr.append_tokens(0, 0)              # n=0 is a no-op
+        assert mgr.seq_len(0) == 9 and mgr.free_blocks == free0 - 2
+        with pytest.raises(ValueError):
+            mgr.append_tokens(0, -1)
+
+    def test_append_tokens_all_or_nothing(self):
+        mgr = BlockCacheManager(num_blocks=3, block_size=4,
+                                max_blocks_per_seq=8)
+        mgr.allocate(0, 4)                   # 1 block used, 2 free
+        with pytest.raises(KVCacheExhausted) as ei:
+            mgr.append_tokens(0, 12)         # needs 3 more blocks, 2 free
+        assert ei.value.need == 3 and ei.value.free == 2
+        # neither the length nor the table moved: retry with a smaller n
+        # (the scheduler's drop-the-drafts degrade path) succeeds
+        assert mgr.seq_len(0) == 4 and mgr.free_blocks == 2
+        mgr.append_tokens(0, 8)
+        assert mgr.seq_len(0) == 12 and mgr.free_blocks == 0
+
+        mgr2 = BlockCacheManager(num_blocks=64, block_size=4,
+                                 max_blocks_per_seq=2)
+        mgr2.allocate(0, 4)
+        with pytest.raises(SequenceTooLong):
+            mgr2.append_tokens(0, 8)         # would need 3 > 2 blocks
+        assert mgr2.seq_len(0) == 4 and mgr2.free_blocks == 63
+
+    def test_append_tokens_then_trim_rollback_exact(self):
+        """The speculative accept/reject cycle: reserve pending + K draft
+        slots, reject some, `trim` back — seq_len and the free pool must
+        land exactly where a plain single-token step would have put them."""
+        mgr = BlockCacheManager(num_blocks=16, block_size=4,
+                                max_blocks_per_seq=8)
+        mgr.allocate(0, 7)
+        mgr.allocate(1, 2)
+        for accepted in (0, 1, 3):
+            pre_len = mgr.seq_len(0)
+            pre_free = mgr.free_blocks
+            pre_blocks = list(mgr._tables[0])
+            mgr.append_tokens(0, 4)          # pending + 3 drafts
+            mgr.trim(0, pre_len + 1 + accepted)
+            assert mgr.seq_len(0) == pre_len + 1 + accepted
+            need = mgr.blocks_needed(pre_len + 1 + accepted)
+            assert mgr.free_blocks == pre_free - (need - len(pre_blocks))
+            # surviving prefix of the table is untouched
+            assert mgr._tables[0][:len(pre_blocks)] == pre_blocks[:need]
+            mgr.trim(0, pre_len)             # full rollback
+            assert mgr.seq_len(0) == pre_len
+            assert mgr.free_blocks == pre_free
+            assert mgr._tables[0] == pre_blocks
+        assert mgr.seq_len(1) == 2           # bystander untouched
+
+    def test_block_table_array_pad_value(self):
+        mgr = BlockCacheManager(num_blocks=8, block_size=4,
+                                max_blocks_per_seq=4)
+        mgr.allocate(0, 5)
+        t = mgr.block_table_array([0], pad=7)
+        assert t.shape == (1, 4)
+        assert list(t[0][2:]) == [7, 7]      # entries past the allocation
+        assert len(set(t[0][:2])) == 2       # real blocks kept
 
     def test_utilization_and_trim(self):
         mgr = BlockCacheManager(num_blocks=8, block_size=4,
@@ -367,6 +454,327 @@ class TestMetrics:
         text = prof.summary()
         assert "Serving:" in text and "TTFT" in text
         assert "occupancy avg" in text
+
+    def test_profiler_summary_speculative_line(self):
+        from paddle_tpu import profiler
+
+        fe = ServingFrontend(
+            make_mlp_engine(),
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+        prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+        prof.start()
+        fe.submit([1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=6)
+        fe.run_until_idle(max_steps=100)
+        prof.stop()
+        text = prof.summary()
+        assert "speculative:" in text and "drafts accepted" in text
+
+    def test_latency_and_spec_samples_stay_bounded(self):
+        """Regression for the bounded-reservoir contract: a long-running
+        server must keep every sample list capped at the window size, no
+        matter how many requests/steps it has seen."""
+        from types import SimpleNamespace
+
+        from paddle_tpu.serving.metrics import _WINDOW
+
+        m = ServingMetrics()
+        req = SimpleNamespace(status=RequestStatus.FINISHED,
+                              ttft=lambda: 0.01, tpot=lambda: 0.001)
+        for _ in range(2 * _WINDOW + 17):
+            m.on_first_token(req)
+            m.on_finish(req)
+            m.on_spec(proposed=4, accepted=2, produced=3, lanes=1)
+        assert len(m.ttft_s) == _WINDOW and m.ttft_s.maxlen == _WINDOW
+        assert len(m.tpot_s) == _WINDOW and m.tpot_s.maxlen == _WINDOW
+        assert len(m.accept_rate) == _WINDOW
+        assert m.accept_rate.maxlen == _WINDOW
+        # summary still computes from the capped window
+        s = m.summary()
+        assert s["serving.ttft_p50_ms"] == pytest.approx(10.0)
+        assert monitor.get("serving.spec_acceptance_pct") == 50.0
+
+
+# ---------------------------------------------------------------------------
+# Device-side fused batched sampling (ops/sampling.py)
+# ---------------------------------------------------------------------------
+
+class TestFusedSampler:
+    def test_greedy_is_argmax_2d_and_3d(self):
+        rng = np.random.default_rng(0)
+        lg = rng.normal(size=(3, 17)).astype(np.float32)
+        z = np.zeros(3, np.int32)
+        got = sample_tokens(lg, np.zeros(3, np.float32), z, z, z)
+        np.testing.assert_array_equal(got, lg.argmax(-1))
+        lg3 = rng.normal(size=(3, 4, 17)).astype(np.float32)
+        got3 = sample_tokens(lg3, np.zeros(3, np.float32), z, z, z)
+        assert got3.shape == (3, 4)
+        np.testing.assert_array_equal(got3, lg3.argmax(-1))
+
+    def test_counter_stream_slot_offset_contract(self):
+        """Slot s of a [B, S, V] draw must equal a [B, V] draw at counter
+        draw_idx + s — the property that makes speculative sampling
+        reproduce exactly what sequential decode would have sampled."""
+        rng = np.random.default_rng(1)
+        lg = rng.normal(size=(2, 3, 33)).astype(np.float32)
+        temps = np.asarray([0.7, 1.3], np.float32)
+        topk = np.asarray([0, 5], np.int32)
+        seeds = np.asarray([11, 42], np.int32)
+        draws = np.asarray([4, 9], np.int32)
+        multi = sample_tokens(lg, temps, topk, seeds, draws)
+        for s in range(3):
+            single = sample_tokens(lg[:, s, :], temps, topk, seeds,
+                                   draws + s)
+            np.testing.assert_array_equal(multi[:, s], single)
+
+    def test_seeded_determinism_and_seed_sensitivity(self):
+        rng = np.random.default_rng(2)
+        lg = np.broadcast_to(rng.normal(size=(1, 64)),
+                             (8, 64)).astype(np.float32).copy()
+        temps = np.full(8, 1.0, np.float32)
+        z = np.zeros(8, np.int32)
+        seeds = np.arange(8, dtype=np.int32)
+        a = sample_tokens(lg, temps, z, seeds, z)
+        b = sample_tokens(lg, temps, z, seeds, z)
+        np.testing.assert_array_equal(a, b)        # same counters -> same
+        # different draw counters move the stream
+        c = sample_tokens(lg, temps, z, seeds, z + 1)
+        assert (a != c).any()
+        # identical logits, different per-request seeds -> diverse picks
+        assert len(set(a.tolist())) > 1
+
+    def test_top_k_restricts_support(self):
+        v = 32
+        lg = np.full((1, v), -5.0, np.float32)
+        lg[0, 7] = 4.0
+        lg[0, 19] = 3.5
+        temps = np.full(1, 1.5, np.float32)
+        topk = np.asarray([2], np.int32)
+        for d in range(50):
+            tok = sample_tokens(lg, temps, topk,
+                                np.asarray([3], np.int32),
+                                np.asarray([d], np.int32))
+            assert int(tok[0]) in (7, 19)
+
+    def test_mixed_greedy_and_stochastic_lanes(self):
+        rng = np.random.default_rng(3)
+        lg = rng.normal(size=(4, 21)).astype(np.float32)
+        temps = np.asarray([0.0, 1.0, 0.0, 2.0], np.float32)
+        z = np.zeros(4, np.int32)
+        got = sample_tokens(lg, temps, z, np.arange(4, dtype=np.int32), z)
+        assert got[0] == lg[0].argmax() and got[2] == lg[2].argmax()
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (serving/spec.py + scheduler integration)
+# ---------------------------------------------------------------------------
+
+def _rep_prompts(n, rng=None):
+    """Repetition-leaning prompt mix (what prompt-lookup is for) plus
+    plain random prompts."""
+    rng = rng or np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        if i % 2:
+            phrase = rng.integers(1, VOCAB, int(rng.integers(2, 4))).tolist()
+            out.append((phrase * 5)[:int(rng.integers(6, 13))])
+        else:
+            out.append(rng.integers(1, VOCAB, rng.integers(2, 12)).tolist())
+    return out
+
+
+class TestNGramProposer:
+    def test_suffix_match_proposes_continuation(self):
+        p = NGramProposer(max_ngram=3)
+        assert p.propose(0, np.asarray([1, 2, 3, 9, 8, 7, 1, 2, 3]),
+                         3) == [9, 8, 7]
+
+    def test_self_extension_on_cyclic_tail(self):
+        p = NGramProposer(max_ngram=3)
+        # constant tail keeps extending instead of truncating at the
+        # rightmost match (one token from the end)
+        assert p.propose(0, np.asarray([5, 6, 7, 7, 7]), 4) == [7, 7, 7, 7]
+        assert p.propose(0, np.asarray([9, 1, 2, 1, 2, 1]),
+                         4) == [2, 1, 2, 1]
+
+    def test_no_match_and_degenerate_contexts(self):
+        p = NGramProposer()
+        assert p.propose(0, np.asarray([1, 2, 3, 4]), 4) == []
+        assert p.propose(0, np.asarray([5]), 4) == []
+        assert p.propose(0, np.asarray([], np.int32), 4) == []
+        p.release(0)  # stateless no-op
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            NGramProposer(max_ngram=2, min_ngram=3)
+        with pytest.raises(ValueError):
+            SpecDecodeConfig(NGramProposer(), num_draft_tokens=0)
+
+
+class TestSpeculative:
+    def _run(self, engine, plist, spec=None, temperature=0.0, seed=0,
+             max_new=7):
+        fe = ServingFrontend(engine, spec=spec)
+        hs = [fe.submit(p, max_new_tokens=max_new, temperature=temperature,
+                        seed=seed)
+              for p in plist]
+        fe.run_until_idle(max_steps=2000)
+        assert all(h.status is RequestStatus.FINISHED for h in hs)
+        return [h.tokens for h in hs]
+
+    def test_greedy_parity_both_engines(self, engine_factory):
+        """Acceptance criterion: token-for-token greedy parity of the
+        speculative path vs plain decode, for both EngineCore impls."""
+        plist = _rep_prompts(9)
+        base = self._run(engine_factory(), plist)
+        spec = self._run(
+            engine_factory(), plist,
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+        assert base == spec
+        assert monitor.get("serving.spec_steps") > 0
+
+    def test_stochastic_parity_via_counter_rng(self, engine_factory):
+        """The counter-based per-request RNG extends parity beyond greedy:
+        temperature sampling draws slot s with counter draw_idx + s, so a
+        speculative run samples EXACTLY the tokens sequential decode
+        would (acceptance compares drafts against the sampled stream)."""
+        plist = _rep_prompts(6)
+        base = self._run(engine_factory(), plist, temperature=0.8, seed=7)
+        spec = self._run(
+            engine_factory(), plist,
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3),
+            temperature=0.8, seed=7)
+        assert base == spec
+
+    def test_zero_retraces_in_steady_state(self, engine_factory):
+        """Fixed-K fixed-shape verify + fused sampling: after a warmup
+        round, long speculative runs never retrace prefill/verify/sample."""
+        fe = ServingFrontend(
+            engine_factory(),
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+        rng = np.random.default_rng(0)
+        for n in (2, 5, 9, 14):   # cover the prefill buckets + spec shapes
+            fe.submit(rng.integers(1, VOCAB, n).tolist(), max_new_tokens=3)
+        fe.run_until_idle(max_steps=300)
+        for c in ("serving.prefill_retraces", "serving.verify_retraces",
+                  "serving.sample_retraces", "serving.decode_retraces"):
+            monitor.reset(c)
+        hs = [fe.submit(p, max_new_tokens=6) for p in _rep_prompts(10)]
+        fe.run_until_idle(max_steps=2000)
+        assert all(h.status is RequestStatus.FINISHED for h in hs)
+        for c in ("serving.prefill_retraces", "serving.verify_retraces",
+                  "serving.sample_retraces", "serving.decode_retraces"):
+            assert monitor.get(c) == 0, f"{c} = {monitor.get(c)}"
+
+    def test_acceptance_metrics_published(self):
+        eng = make_mlp_engine()
+        self._run(eng, [[1, 2, 3] * 4], max_new=8,
+                  spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+        assert monitor.get("serving.spec_steps") > 0
+        assert monitor.get("serving.spec_proposed_tokens") >= \
+            monitor.get("serving.spec_accepted_tokens")
+        assert monitor.get("serving.spec_tokens_per_lane_step") >= 1.0
+        acc = monitor.get("serving.spec_acceptance_pct")
+        assert 0.0 <= acc <= 100.0
+
+    def test_draft_engine_proposer_perfect_drafts(self):
+        """A draft engine with the TARGET's weights drafts greedily exactly
+        what the target verifies: every proposed token is accepted, and
+        the draft cache pool drains back to full when requests finish."""
+        target = make_mlp_engine()
+        draft = make_mlp_engine()   # same seed -> identical weights
+        proposer = DraftEngineProposer(draft)
+        plist = _rep_prompts(6)
+        base = self._run(make_mlp_engine(), plist)
+        spec = self._run(
+            target, plist,
+            spec=SpecDecodeConfig(proposer, num_draft_tokens=3))
+        assert base == spec
+        assert monitor.get("serving.spec_proposed_tokens") > 0
+        assert monitor.get("serving.spec_accepted_tokens") == \
+            monitor.get("serving.spec_proposed_tokens")
+        assert draft.manager.free_blocks == 48   # all leases released
+
+    def test_draft_proposer_context_over_draft_cap_degrades(self):
+        """Regression: a verified context longer than the DRAFT cache's
+        per-sequence cap must degrade to 'no proposal' — the bucket
+        doubling in `_prefill` used to saturate below the context length
+        and spin forever, freezing the serving loop."""
+        draft = make_mlp_engine(max_blocks_per_seq=2)   # draft cap: 8
+        proposer = DraftEngineProposer(draft)
+        assert proposer.propose(0, np.arange(1, 12, dtype=np.int32), 3) == []
+        assert draft.manager.free_blocks == 48          # nothing leaked
+        # and a synced sequence whose context outgrows the cap mid-stream
+        assert proposer.propose(1, np.arange(1, 7, dtype=np.int32), 3) != []
+        assert proposer.propose(1, np.arange(1, 30, dtype=np.int32), 3) == []
+
+    def test_huge_seed_does_not_crash_decode(self, engine_factory):
+        """Regression: numpy >= 2.0 raises OverflowError constructing an
+        int32 array from seed >= 2**31; the sampler arrays must mask user
+        ints instead of killing the decode step for every lane."""
+        fe = ServingFrontend(engine_factory())
+        h = fe.submit([1, 2, 3], max_new_tokens=4, temperature=0.9,
+                      seed=2 ** 40 + 5, top_k=2 ** 33)
+        fe.run_until_idle(max_steps=200)
+        assert h.status is RequestStatus.FINISHED
+        assert len(h.tokens) == 4
+
+    def test_spec_parity_under_preemption_pressure(self):
+        """KV pressure: the spec path's degrade-then-preempt growth keeps
+        per-request token streams identical to the plain scheduler's
+        (tokens-so-far survive preemption; greedy continuations are
+        deterministic regardless of scheduling order)."""
+        def tight():
+            return make_mlp_engine(max_batch=4, num_blocks=12,
+                                   max_blocks_per_seq=6)
+
+        plist = [p[:8] for p in _rep_prompts(7)]
+        base = self._run(tight(), plist, max_new=6)
+        spec = self._run(
+            tight(), plist, max_new=6,
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+        assert base == spec
+
+    def test_spec_parity_at_length_cap(self, engine_factory, llama_model):
+        """A lane within S tokens of its hard length cap keeps a table
+        FULL of real blocks while the fixed-shape verify still lays out S
+        positions, so the final rounds exercise the clamped `_grow_n`
+        growth, the past-the-cap guard columns of the verify table (a
+        narrow table would send those KV writes through an OOB-gather
+        int32 wraparound into physical block 0 — see `_decode_spec`), and
+        the `trim` bookkeeping right up to the `length_cap` finish. The
+        pool is sized so every block (incl. block 0) is leased."""
+        def tight():
+            if engine_factory is make_mlp_engine:
+                return make_mlp_engine(num_blocks=10, max_blocks_per_seq=3)
+            return LlamaInferenceEngine(llama_model, max_batch_size=4,
+                                        num_blocks=10, block_size=4,
+                                        max_blocks_per_seq=3)   # cap: 12
+
+        # cyclic prompts keep the proposer drafting right up to the cap
+        plist = [[1, 2, 3] * 2, [5, 6] * 4, [9, 8] * 4]
+        base = self._run(tight(), plist, max_new=12)
+        spec = self._run(
+            tight(), plist, max_new=12,
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=4))
+        assert base == spec
+
+    def test_failing_proposer_degrades_to_plain_decode(self, engine_factory):
+        """A proposer that raises must never kill the serving loop — the
+        round degrades to zero drafts (plain decode via verify)."""
+        class Hostile:
+            def propose(self, seq_id, context, k):
+                raise RuntimeError("boom")
+
+            def release(self, seq_id):
+                raise RuntimeError("boom on release too")
+
+        plist = _rep_prompts(5)
+        base = self._run(engine_factory(), plist)
+        spec = self._run(engine_factory(), plist,
+                         spec=SpecDecodeConfig(Hostile(),
+                                               num_draft_tokens=3))
+        assert base == spec
+        assert monitor.get("serving.spec_accepted_tokens") == 0
 
 
 # ---------------------------------------------------------------------------

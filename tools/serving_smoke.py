@@ -1,7 +1,11 @@
 """Serving smoke: drive 16 short requests through the continuous-batching
 frontend on CPU and assert (1) every request completes, (2) the decode path
 performs ZERO recompiles after warmup, (3) serving metrics are present and
-monotone. Tier-1-safe: finishes well under 60 s on CPU.
+monotone, then re-run the SAME trace through a speculative-decoding
+frontend (n-gram proposer + batched verify) over the same weights and
+assert (4) greedy token-for-token parity with the non-speculative run and
+(5) zero steady-state retraces on the verify/sample paths too.
+Tier-1-safe: finishes well under 60 s on CPU.
 
 Usage:
     python tools/serving_smoke.py [--engine llama|mlp] [--requests 16]
@@ -25,6 +29,8 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
+_LLAMA_MODEL = None
+
 
 def build_engine(kind: str):
     if kind == "mlp":
@@ -32,13 +38,46 @@ def build_engine(kind: str):
 
         return MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
                            num_blocks=48, block_size=4, max_blocks_per_seq=8)
-    from paddle_tpu.inference import LlamaInferenceEngine
-    from paddle_tpu.models import llama_tiny
+    # ONE model for every engine this process builds: the speculative pass
+    # asserts token parity against the plain pass, so both must serve the
+    # same weights
+    global _LLAMA_MODEL
+    if _LLAMA_MODEL is None:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import llama_tiny
 
-    model = llama_tiny(vocab=64, layers=2, hidden=32, heads=2, seq=64)
-    model.eval()
-    return LlamaInferenceEngine(model, max_batch_size=4, num_blocks=48,
-                                block_size=4, max_blocks_per_seq=8)
+        paddle.seed(0)   # reproducible acceptance numbers across runs
+        _LLAMA_MODEL = llama_tiny(vocab=64, layers=2, hidden=32, heads=2,
+                                  seq=64)
+        _LLAMA_MODEL.eval()
+    from paddle_tpu.inference import LlamaInferenceEngine
+
+    return LlamaInferenceEngine(_LLAMA_MODEL, max_batch_size=4,
+                                num_blocks=48, block_size=4,
+                                max_blocks_per_seq=8)
+
+
+def drive(fe, warm_prompts, prompts, monitor):
+    """Warmup (compile coverage) -> counter reset -> run `prompts`.
+    Returns the request handles of the measured run."""
+    from paddle_tpu.serving import RequestStatus
+
+    warm = [fe.submit(p, max_new_tokens=3) for p in warm_prompts]
+    fe.run_until_idle(max_steps=500)
+    assert all(h.status is RequestStatus.FINISHED for h in warm), warm
+    # prefill always compiles on a fresh engine (the decode counter would
+    # stay 0 on the speculative pass, which decodes via verify_step)
+    assert monitor.get("serving.prefill_retraces") >= 1, "never compiled?"
+
+    for c in ("serving.decode_retraces", "serving.prefill_retraces",
+              "serving.verify_retraces", "serving.sample_retraces"):
+        monitor.reset(c)
+    fe.metrics.reset_window()   # warmup latencies are not the smoke's
+    handles = [fe.submit(p, max_new_tokens=g) for p, g in prompts]
+    fe.run_until_idle(max_steps=2000)
+    bad = [h for h in handles if h.status is not RequestStatus.FINISHED]
+    assert not bad, f"unfinished: {bad}"
+    return handles
 
 
 def main():
@@ -48,47 +87,55 @@ def main():
     args = ap.parse_args()
 
     from paddle_tpu.framework import monitor
-    from paddle_tpu.serving import RequestStatus, ServingFrontend
+    from paddle_tpu.serving import (NGramProposer, ServingFrontend,
+                                    SpecDecodeConfig)
 
     t0 = time.time()
-    fe = ServingFrontend(build_engine(args.engine))
     rng = np.random.default_rng(0)
+    warm_prompts = [rng.integers(1, 64, n).tolist() for n in (2, 5, 9, 14)]
+    # repetition-leaning prompts so the n-gram proposer has something to
+    # match, mixed with plain random ones
+    prompts = []
+    for i in range(args.requests):
+        if i % 2:
+            phrase = rng.integers(1, 64, int(rng.integers(2, 4))).tolist()
+            p = (phrase * 5)[:int(rng.integers(6, 13))]
+        else:
+            p = rng.integers(1, 64, rng.integers(2, 14)).tolist()
+        prompts.append((p, int(rng.integers(2, 7))))
 
-    # warmup: run a few requests covering the prefill buckets + decode shape
-    warm = [fe.submit(rng.integers(1, 64, n).tolist(), max_new_tokens=3)
-            for n in (2, 5, 9, 14)]
-    fe.run_until_idle(max_steps=500)
-    assert all(h.status is RequestStatus.FINISHED for h in warm), warm
-    assert monitor.get("serving.decode_retraces") >= 1, "never compiled?"
+    # ---- pass 1: plain decode ----
+    fe = ServingFrontend(build_engine(args.engine))
+    handles = drive(fe, warm_prompts, prompts, monitor)
 
-    monitor.reset("serving.decode_retraces")
-    monitor.reset("serving.prefill_retraces")
-    fe.metrics.reset_window()   # warmup latencies are not the smoke's
-    before = {k: monitor.get(k) for k in
-              ("serving.requests_completed", "serving.tokens_generated",
-               "serving.decode_steps")}
-
-    handles = [fe.submit(rng.integers(1, 64, rng.integers(2, 14)).tolist(),
-                         max_new_tokens=int(rng.integers(2, 7)))
-               for _ in range(args.requests)]
-    fe.run_until_idle(max_steps=2000)
-
-    # 1. completion
-    bad = [h for h in handles if h.status is not RequestStatus.FINISHED]
-    assert not bad, f"unfinished: {bad}"
-
-    # 2. zero recompiles after warmup
+    # zero recompiles after warmup
     assert monitor.get("serving.decode_retraces") == 0, \
         f"decode retraced {monitor.get('serving.decode_retraces')}x"
     assert monitor.get("serving.prefill_retraces") == 0, \
         f"prefill retraced {monitor.get('serving.prefill_retraces')}x"
 
-    # 3. monotone metrics
-    after = {k: monitor.get(k) for k in before}
-    for k in before:
-        assert after[k] > before[k], f"{k} did not advance: {before[k]}"
+    # monotone metrics
+    after = {k: monitor.get(k) for k in
+             ("serving.requests_completed", "serving.tokens_generated",
+              "serving.decode_steps")}
+    for k, v in after.items():
+        assert v > 0, f"{k} did not advance"
     s = fe.summary()
     assert s["serving.ttft_p50_ms"] <= s["serving.ttft_p99_ms"]
+
+    # ---- pass 2: speculative decode, same weights + trace ----
+    fe2 = ServingFrontend(
+        build_engine(args.engine),
+        spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3))
+    handles2 = drive(fe2, warm_prompts, prompts, monitor)
+
+    for i, (a, b) in enumerate(zip(handles, handles2)):
+        assert a.tokens == b.tokens, \
+            f"req {i}: greedy parity broken: {a.tokens} != {b.tokens}"
+    for c in ("serving.decode_retraces", "serving.prefill_retraces",
+              "serving.verify_retraces", "serving.sample_retraces"):
+        assert monitor.get(c) == 0, f"{c} retraced {monitor.get(c)}x"
+    assert monitor.get("serving.spec_steps") > 0, "spec path never ran"
 
     print(json.dumps({
         "ok": True, "engine": args.engine, "requests": len(handles),
@@ -98,6 +145,10 @@ def main():
         "ttft_p50_ms": s["serving.ttft_p50_ms"],
         "ttft_p99_ms": s["serving.ttft_p99_ms"],
         "occupancy_avg_pct": s.get("serving.batch_occupancy_avg_pct"),
+        "spec_greedy_parity": True,
+        "spec_acceptance_pct": monitor.get("serving.spec_acceptance_pct"),
+        "spec_tokens_per_lane_step":
+            monitor.get("serving.spec_tokens_per_lane_step"),
     }))
 
 
