@@ -65,7 +65,8 @@ class _ProbeSkipped(Exception):
     """Non-retryable probe abort; str(exc) is the `skipped_reason`."""
 
 
-def _probe_tpu(timeouts=(180.0, 300.0, 300.0), budget_s=None):
+def _probe_tpu(timeouts=(180.0, 300.0, 300.0), budget_s=None,
+               scenario="train_mfu"):
     """Probe the TPU backend from a throwaway subprocess; return a
     diagnostics dict that goes verbatim into the bench JSON.
 
@@ -76,18 +77,28 @@ def _probe_tpu(timeouts=(180.0, 300.0, 300.0), budget_s=None):
 
     Round-6 hardening (BENCH_r05 burned two back-to-back 120 s timeouts on
     the same platform before falling back): the probe keeps a TOTAL
-    wall-clock budget (`BENCH_PROBE_BUDGET_S`, default 420 s) that clamps
-    every attempt's window; a TIMED-OUT attempt short-circuits the
-    remaining retries outright — a runtime bring-up that hung once will
-    hang again on the same platform, only a fast non-zero exit is worth
-    retrying. Whenever the probe gives up, `skipped_reason` says why
-    (`first_timeout_on_<platform>` / `budget_exhausted` / `probe_failed`)
-    so the artifact explains the CPU fallback by itself."""
+    wall-clock budget that clamps every attempt's window; a TIMED-OUT
+    attempt short-circuits the remaining retries outright — a runtime
+    bring-up that hung once will hang again on the same platform, only a
+    fast non-zero exit is worth retrying. Whenever the probe gives up,
+    `skipped_reason` says why (`first_timeout_on_<platform>` /
+    `budget_exhausted` / `probe_failed`) so the artifact explains the CPU
+    fallback by itself.
+
+    Round-7 hardening (r04/r05 lost EVERY TPU datapoint to one global
+    budget): each scenario owns its own probe budget and its own
+    `skipped_reason` — `BENCH_PROBE_BUDGET_S` is the per-scenario default
+    and `BENCH_PROBE_BUDGET_<SCENARIO>_S` overrides one scenario, so a
+    train-MFU probe timeout no longer blinds `serving_throughput` (and
+    vice versa)."""
     if budget_s is None:
-        budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "420"))
+        env = os.environ.get(f"BENCH_PROBE_BUDGET_{scenario.upper()}_S")
+        budget_s = float(env if env is not None
+                         else os.environ.get("BENCH_PROBE_BUDGET_S", "420"))
     retry = _load_retry_standalone()
     platform = os.environ.get("JAX_PLATFORMS") or "default"
-    diag = {"ok": False, "attempts": [], "budget_s": budget_s}
+    diag = {"ok": False, "scenario": scenario, "attempts": [],
+            "budget_s": budget_s}
     t_start = time.time()
 
     def attempt_once():
@@ -132,6 +143,32 @@ def _probe_tpu(timeouts=(180.0, 300.0, 300.0), budget_s=None):
         return diag
     diag["ok"] = True
     return diag
+
+
+def _scenario_setup(scenario):
+    """Per-scenario platform selection: run this scenario's OWN TPU probe
+    (own budget, own `skipped_reason`) and fall back to CPU on failure.
+    Returns the probe diagnostics dict for the scenario's extras — every
+    bench JSON now explains its own platform choice instead of
+    inheriting one global short-circuit."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        probe = {"ok": False, "scenario": scenario,
+                 "skipped_reason": "forced_cpu"}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    elif os.environ.get("JAX_PLATFORMS") == "cpu":
+        probe = {"ok": False, "scenario": scenario,
+                 "skipped_reason": "env_pinned_cpu"}
+    else:
+        probe = _probe_tpu(scenario=scenario)
+        if not probe["ok"]:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The TPU-plugin sitecustomize re-forces its own platform over the
+        # env var; the config update wins (same dance as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    return probe
 
 
 _LAST_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -391,6 +428,28 @@ def _decode_microbench(on_tpu: bool):
     return out
 
 
+def _drive_poisson(fe, arrivals, submit_one):
+    """Open-loop Poisson driver shared by the throughput and overload
+    scenarios: submit each request at its arrival offset (sleeping only
+    when the engine is idle AND nothing is due), stepping the scheduler
+    otherwise, until every arrival is in and the frontend drains.
+    Returns (handles, wall_s)."""
+    handles = []
+    n = len(arrivals)
+    t0 = time.perf_counter()
+    i = 0
+    while i < n or not fe.scheduler.idle:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            handles.append(submit_one(i))
+            i += 1
+        if fe.scheduler.idle and i < n:
+            time.sleep(max(0.0, arrivals[i] - (time.perf_counter() - t0)))
+            continue
+        fe.step()
+    return handles, time.perf_counter() - t0
+
+
 def serving_throughput_main():
     """`python bench.py serving_throughput` — continuous-batching serving
     under a Poisson arrival trace (open-loop). CPU-runnable; on TPU the
@@ -398,13 +457,12 @@ def serving_throughput_main():
 
     Prints ONE JSON line: tok/s generated, p50/p99/mean TTFT, batch
     occupancy, KV utilization, preemptions, and the decode retrace count
-    after warmup (must be 0 — the zero-recompile steady state)."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    after warmup (must be 0 — the zero-recompile steady state); extras
+    also carry an `overload` sub-report (4x-capacity Poisson burst with
+    admission control: shed/admit counts, shed-rejection latency, and
+    admitted-TTFT degradation vs the 1x burst on the same stack)."""
+    probe = _scenario_setup("serving_throughput")
     import jax
-
-    if os.environ["JAX_PLATFORMS"] == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
 
     from paddle_tpu.framework import monitor
@@ -415,9 +473,14 @@ def serving_throughput_main():
     on_tpu = jax.devices()[0].platform != "cpu"
     model = llama_tiny(vocab=128, layers=2, hidden=64, heads=4, seq=256)
     model.eval()
-    engine = LlamaInferenceEngine(
-        model, max_batch_size=8, num_blocks=128, block_size=8,
-        max_blocks_per_seq=16, **({"dtype": "bfloat16"} if on_tpu else {}))
+
+    def build_engine():
+        return LlamaInferenceEngine(
+            model, max_batch_size=8, num_blocks=128, block_size=8,
+            max_blocks_per_seq=16,
+            **({"dtype": "bfloat16"} if on_tpu else {}))
+
+    engine = build_engine()
     fe = ServingFrontend(engine)
     rng = np.random.default_rng(0)
 
@@ -439,21 +502,12 @@ def serving_throughput_main():
     arrivals = np.cumsum(gaps)
     specs = [(rng.integers(2, 28), int(rng.integers(4, 12)))
              for _ in range(n_requests)]
-    handles = []
-    t0 = time.perf_counter()
-    i = 0
-    while i < n_requests or not fe.scheduler.idle:
-        now = time.perf_counter() - t0
-        while i < n_requests and arrivals[i] <= now:
-            plen, gen = specs[i]
-            handles.append(fe.submit(rng.integers(1, 128, plen).tolist(),
-                                     max_new_tokens=gen))
-            i += 1
-        if fe.scheduler.idle and i < n_requests:
-            time.sleep(max(0.0, arrivals[i] - (time.perf_counter() - t0)))
-            continue
-        fe.step()
-    wall = time.perf_counter() - t0
+    def submit_one(i):
+        plen, gen = specs[i]
+        return fe.submit(rng.integers(1, 128, plen).tolist(),
+                         max_new_tokens=gen)
+
+    handles, wall = _drive_poisson(fe, arrivals, submit_one)
 
     done = sum(h.status is RequestStatus.FINISHED for h in handles)
     tokens = monitor.get("serving.tokens_generated") - base_tokens \
@@ -478,8 +532,11 @@ def serving_throughput_main():
         "prefill_retraces_after_warmup":
             monitor.get("serving.prefill_retraces"),
         "poisson_mean_gap_ms": mean_gap_s * 1e3,
+        "probe": probe,
         "device": jax.devices()[0].device_kind or "cpu",
     }
+    extras["overload"] = _overload_bench(build_engine, tok_s,
+                                         float(np.mean([g for _, g in specs])))
     print(json.dumps({
         "metric": "serving_throughput",
         "value": round(tok_s, 1),
@@ -488,6 +545,154 @@ def serving_throughput_main():
         "vs_baseline": None,
         "extras": extras,
     }))
+
+
+def _overload_bench(build_engine, capacity_tok_s, mean_gen_tokens):
+    """4x-capacity Poisson burst against the admission-controlled stack.
+
+    The acceptance contract (ISSUE 6): overload must degrade to FAST shed
+    rejections, not collapsed TTFT — admitted-request p99 TTFT under the
+    4x burst stays < 2x an unloaded (0.5x) baseline ON THE SAME
+    admission-controlled frontend, and shed requests are rejected in
+    < 5 ms. Both runs share one engine/frontend (drained between bursts)
+    so the comparison isolates load, not compile or cache state.
+
+    Capacity is MEASURED full-batch closed-loop throughput (a saturation
+    run of `lanes` concurrent requests, host loop and prefills included):
+    the open-loop phase's tok/s runs at partial occupancy and would
+    understate the 4x point, while raw `lanes / dispatch_TPOT` ignores
+    per-step host overhead and would overstate it — either error makes
+    the burst multipliers meaningless."""
+    import numpy as np
+
+    from paddle_tpu.serving import (AdmissionConfig, RequestStatus,
+                                    ServingFrontend, ServingMetrics)
+
+    ServingMetrics.reset_monitor()
+    # Tightest queue watermark: admit only into an empty queue. Under
+    # saturation a slot frees roughly every step and each queue position
+    # costs ~a step of TTFT (measured: ~6 ms/position on CPU — qh=3
+    # degraded admitted p99 ~3x), so for a latency-isolation bench the
+    # queue IS the degradation; shed instead. Throughput-leaning
+    # deployments raise the watermark and trade TTFT for goodput
+    # (docs/SERVING.md "watermark tuning").
+    fe = ServingFrontend(
+        build_engine(),
+        admission=AdmissionConfig(queue_high=1, queue_low=0,
+                                  kv_high=0.95, kv_low=0.8))
+    rng = np.random.default_rng(7)
+    # compile coverage before any timing. One request at a time: this
+    # frontend sheds on queue depth, so submitting the four bucket
+    # sizes back-to-back sheds the later ones and leaves their prefill
+    # buckets uncompiled — the first burst request to hit one then pays
+    # the whole compile (~600 ms on CPU) mid-burst, stalling the loop
+    # and latching the shed watermark over everything behind it.
+    for n in (3, 7, 14, 27):
+        fe.submit(rng.integers(1, 128, n).tolist(), max_new_tokens=2)
+        fe.run_until_idle(max_steps=500)
+    # saturation phase: the bucket pass above yields ~1 decode dispatch
+    # per request (max_new_tokens=2 — prefill samples the first token),
+    # so the TPOT window would hold mostly the compile outlier. A
+    # full-batch closed-loop run both fills the median window with
+    # steady-state dispatch times (the deadline-shed estimate) and
+    # measures TRUE end-to-end capacity — host loop, sampling, and
+    # prefill overhead included, which raw `lanes / dispatch_TPOT`
+    # overstates several-fold (that mistake made the "0.5x baseline"
+    # itself saturate). step() after each submit keeps the queue under
+    # the shed watermark (slots are free, so each admits immediately).
+    lanes = len(fe.scheduler.slots)
+    sat_gen = 12
+    t_sat = time.perf_counter()
+    for _ in range(lanes):
+        fe.submit(rng.integers(1, 128, 14).tolist(),
+                  max_new_tokens=sat_gen)
+        fe.step()
+    fe.run_until_idle(max_steps=500)
+    sat_tok_s = lanes * sat_gen / (time.perf_counter() - t_sat)
+
+    def burst(load_x, n_requests, deadline_s, capacity_rps):
+        fe.metrics.reset_window()
+        gaps = rng.exponential(1.0 / (load_x * capacity_rps), n_requests)
+        arrivals = np.cumsum(gaps)
+        handles, _wall = _drive_poisson(
+            fe, arrivals,
+            lambda _i: fe.submit(
+                rng.integers(1, 128, int(rng.integers(2, 20))).tolist(),
+                max_new_tokens=int(rng.integers(4, 12)),
+                timeout_s=deadline_s))
+        non_terminal = sum(not h.finished for h in handles)
+        shed = [h for h in handles if h.status is RequestStatus.SHED]
+        admitted = [h for h in handles if h.status is not RequestStatus.SHED]
+        ttfts = sorted(t for t in (h.ttft_ms() for h in admitted)
+                       if t is not None)
+        shed_ms = sorted((h._req.t_finish - h._req.t_submit) * 1e3
+                         for h in shed)
+        pct = lambda xs, q: (  # noqa: E731
+            round(float(np.percentile(xs, q)), 3) if xs else None)
+        return {
+            "requests": n_requests, "admitted": len(admitted),
+            "shed": len(shed),
+            "non_terminal": non_terminal,
+            "finished": sum(h.status is RequestStatus.FINISHED
+                            for h in handles),
+            "timed_out": sum(h.status is RequestStatus.TIMED_OUT
+                             for h in handles),
+            "admitted_ttft_p50_ms": pct(ttfts, 50),
+            "admitted_ttft_p99_ms": pct(ttfts, 99),
+            "shed_reject_p99_ms": pct(shed_ms, 99),
+        }
+
+    # generous completion deadline: ~mean_gen steps of decode + slack; the
+    # deadline-aware shed uses the measured TPOT against it
+    tpot0 = fe.scheduler.tpot_estimate() or 0.005
+    deadline_s = max(0.05, 24 * tpot0 * 3)
+    full_capacity_rps = sat_tok_s / max(mean_gen_tokens, 1.0)
+    # Three paired (0.5x, 4x) trials, degradation gated on the MEDIAN:
+    # p99 over the ~100 admitted requests of one burst is close to a
+    # max-statistic on a shared CPU — a single GC pause or scheduler
+    # hiccup in either burst would flip a single-shot gate either way.
+    # The burst sizes (256/512) keep each trial's p99 interpolated
+    # rather than literal-max.
+    trials = []
+    for _ in range(3):
+        base = burst(0.5, 256, deadline_s, full_capacity_rps)
+        over = burst(4.0, 512, deadline_s, full_capacity_rps)
+        trials.append((base, over))
+    degs = [round(o["admitted_ttft_p99_ms"] / b["admitted_ttft_p99_ms"], 2)
+            for b, o in trials
+            if b["admitted_ttft_p99_ms"] and o["admitted_ttft_p99_ms"]]
+    base, over = trials[-1]
+    report = {
+        "burst_x": 4.0,
+        "baseline_x": 0.5,
+        "tpot_est_ms": round(tpot0 * 1e3, 2),
+        "full_capacity_rps": round(full_capacity_rps, 1),
+        "saturated_tok_s": round(sat_tok_s, 1),
+        "open_loop_tok_s": round(capacity_tok_s, 1),
+        "baseline_1x": base,
+        "overload_4x": over,
+        "shed_by_reason": ServingMetrics.shed_by_reason(),
+        "ttft_degradation_trials_x": degs,
+        "ttft_degradation_x": (round(float(np.median(degs)), 2)
+                               if degs else None),
+    }
+    # hard in-run checks — an overload regression must fail the bench,
+    # not print a healthy-looking report
+    for b, o in trials:
+        assert o["shed"] > 0, "4x burst shed nothing: admission control dead"
+        assert o["shed_reject_p99_ms"] is not None \
+            and o["shed_reject_p99_ms"] < 5.0, \
+            f"shed rejection too slow: {o['shed_reject_p99_ms']} ms"
+        # the terminal-status contract under load: nothing left hanging
+        # after the drain, in either burst
+        assert b["non_terminal"] == 0 and o["non_terminal"] == 0, \
+            f"requests left non-terminal after drain: " \
+            f"baseline={b['non_terminal']} overload={o['non_terminal']}"
+    if report["ttft_degradation_x"] is not None:
+        assert report["ttft_degradation_x"] < 2.0, \
+            f"admitted p99 TTFT degraded {report['ttft_degradation_x']}x " \
+            f"(median of {degs}) under the 4x burst (bar: < 2x)"
+    return report
 
 
 def serving_spec_main():
@@ -503,12 +708,8 @@ def serving_spec_main():
     metrics, tokens/lane-step, retrace counters, and a token-for-token
     greedy parity check. Each mode runs twice and keeps the faster wall
     clock (the two runs are token-identical; timing is the only noise)."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    probe = _scenario_setup("serving_spec")
     import jax
-
-    if os.environ["JAX_PLATFORMS"] == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
 
     from paddle_tpu.framework import monitor
@@ -597,6 +798,7 @@ def serving_spec_main():
         "decode_retraces_after_warmup": spec["decode_retraces"],
         "verify_retraces_after_warmup": spec["verify_retraces"],
         "sample_retraces_after_warmup": spec["sample_retraces"],
+        "probe": probe,
         "device": jax.devices()[0].device_kind or "cpu",
     }
     print(json.dumps({
@@ -614,7 +816,7 @@ def main():
     extras = {}
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     if not force_cpu:
-        probe = _probe_tpu()
+        probe = _probe_tpu(scenario="train_mfu")
         extras["probe"] = probe
     if force_cpu or not extras.get("probe", {}).get("ok"):
         if not force_cpu and os.environ.get("BENCH_NO_STALE") != "1":

@@ -18,7 +18,7 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
-__all__ = ["RetryDeadlineExceeded", "retry_call"]
+__all__ = ["Budget", "RetryDeadlineExceeded", "retry_call"]
 
 
 class RetryDeadlineExceeded(TimeoutError):
@@ -34,6 +34,32 @@ def _count(monitor_name: Optional[str], delta: int = 1) -> None:
     except ImportError:  # loaded standalone (bench.py pre-jax probe)
         return
     monitor.inc(monitor_name, delta)
+
+
+class Budget:
+    """A spend-down budget shared ACROSS calls — the lifetime analog of
+    `retry_call`'s per-call ``retries``. Used where a recovery action
+    must stay bounded over a process's whole life (the serving
+    watchdog's engine restarts): each recovery calls :meth:`spend`,
+    which answers False once ``limit`` uses are gone, and the caller
+    degrades to its terminal path instead of looping forever."""
+
+    def __init__(self, limit: int, monitor_name: Optional[str] = None):
+        self.limit = int(limit)
+        self.used = 0
+        self.monitor_name = monitor_name
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.limit - self.used)
+
+    def spend(self) -> bool:
+        """Consume one use; False (and no side effects) when exhausted."""
+        if self.used >= self.limit:
+            return False
+        self.used += 1
+        _count(self.monitor_name)
+        return True
 
 
 def retry_call(fn: Callable, *args,

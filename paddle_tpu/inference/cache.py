@@ -14,11 +14,22 @@ raise the typed `KVCacheExhausted` (pool empty) or `SequenceTooLong`
 """
 from __future__ import annotations
 
+import sys as _sys
 from typing import Dict, List
 
 import numpy as np
 
 __all__ = ["BlockCacheManager", "KVCacheExhausted", "SequenceTooLong"]
+
+
+def _chaos(site: str) -> None:
+    """`serve.cache` fault-injection site (resilience.faults). Active
+    only when the registry module is already loaded AND armed — cache
+    ops in processes that never touch fault injection pay one
+    sys.modules lookup, no import."""
+    mod = _sys.modules.get("paddle_tpu.resilience.faults")
+    if mod is not None:
+        mod.check(site)
 
 
 class KVCacheExhausted(RuntimeError):
@@ -91,6 +102,7 @@ class BlockCacheManager:
         """
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
+        _chaos("serve.cache")
         need = self.blocks_needed(num_tokens)
         if need > self.max_blocks_per_seq:
             raise SequenceTooLong(need, self.max_blocks_per_seq)
@@ -117,6 +129,7 @@ class BlockCacheManager:
         rejected speculations) is `trim(seq_id, old_len)`."""
         if n < 0:
             raise ValueError(f"append_tokens: n must be >= 0, got {n}")
+        _chaos("serve.cache")
         new_len = self._lens[seq_id] + n
         table = self._tables[seq_id]
         need = self.blocks_needed(new_len) - len(table)
@@ -151,6 +164,12 @@ class BlockCacheManager:
 
     def seq_len(self, seq_id: int) -> int:
         return self._lens[seq_id]
+
+    def seq_blocks(self, seq_id: int) -> int:
+        """Number of physical blocks currently leased by `seq_id` (0 for
+        an unknown sequence). Lets the serving watchdog audit for leaks
+        without reaching into private tables."""
+        return len(self._tables.get(seq_id, ()))
 
     def block_table_array(self, seq_ids, pad: int = 0) -> np.ndarray:
         """Dense [len(seq_ids), max_blocks_per_seq] int32 table.

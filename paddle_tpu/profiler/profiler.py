@@ -478,4 +478,23 @@ class Profiler:
         if rejected:
             lines.append("  reject reasons: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(rejected.items())))
+        # Overload/faults block: only rendered when the fault-tolerance
+        # layer actually acted (shed, isolated, restarted, or stalled)
+        if (g("serving.shed_total") or g("serving.isolated_faults")
+                or g("serving.step_faults") or g("serving.engine_restarts")
+                or g("serving.stall_detections")
+                or g("serving.requests_failed")):
+            from ..serving.metrics import ServingMetrics
+
+            shed_by = ServingMetrics.shed_by_reason()
+            lines.append(
+                f"  overload/faults: {g('serving.shed_total')} shed, "
+                f"{g('serving.isolated_faults')} isolated faults, "
+                f"{g('serving.step_faults')} transient step faults, "
+                f"{g('serving.requests_failed')} failed, "
+                f"{g('serving.engine_restarts')} engine restarts, "
+                f"{g('serving.stall_detections')} stall detections")
+            if shed_by:
+                lines.append("  shed reasons: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(shed_by.items())))
         return lines

@@ -25,6 +25,16 @@ attempt), ``ckpt.complete`` (before the COMPLETE marker),
 ``guard.step`` (before the wrapped train step runs), ``guard.nan_loss``
 (flag: poison the step's loss), ``guard.preempt`` (before the step,
 for kill/sigterm).
+
+Serving sites (`serving/scheduler.py` via :func:`check_flag`, and
+`inference/cache.py`): ``serve.prefill`` / ``serve.decode`` /
+``serve.verify`` (per engine dispatch; ``action="flag"`` asks the
+scheduler to poison one lane's logits with NaN instead of raising),
+``serve.sample`` (per fused-sampler call), ``serve.cache`` (per
+`BlockCacheManager.allocate`/`append_tokens`). An ``exc`` that is an
+`serving.EngineStepError` with ``seq_ids`` drives the targeted
+lane-isolation path; the default `InjectedIOError` drives the
+transient-retry path. See docs/SERVING.md "Failure semantics".
 """
 from __future__ import annotations
 
@@ -34,7 +44,7 @@ import threading
 from typing import Dict, Optional
 
 __all__ = ["InjectedFault", "InjectedIOError", "inject", "clear", "check",
-           "fires", "state"]
+           "check_flag", "fires", "state"]
 
 
 class InjectedFault(Exception):
@@ -87,6 +97,8 @@ def clear(site: Optional[str] = None) -> None:
 
 def _consume(site: str) -> Optional[_Rule]:
     """Count one call at ``site``; return the rule iff this call fires."""
+    if not _rules:   # fast path: instrumented hot paths (the serving
+        return None  # decode loop, cache ops) pay one dict check unarmed
     with _lock:
         rule = _rules.get(site)
         if rule is None:
@@ -109,14 +121,25 @@ def fires(site: str) -> bool:
 def check(site: str) -> None:
     """Count one call; deliver the armed fault (raise / kill / sigterm)
     if this call fires. A ``"flag"`` rule never raises from ``check``."""
+    check_flag(site)
+
+
+def check_flag(site: str) -> bool:
+    """:func:`check`, but additionally report whether a ``"flag"`` rule
+    fired at THIS call — for sites where the caller applies the fault to
+    its own output (the serving scheduler poisons one lane's logits with
+    NaN; StepGuard substitutes a NaN loss). One call = one count: a site
+    never has to choose between ``check`` and ``fires``."""
     rule = _consume(site)
-    if rule is None or rule.action == "flag":
-        return
+    if rule is None:
+        return False
+    if rule.action == "flag":
+        return True
     if rule.action == "kill":
         os.kill(os.getpid(), _signal.SIGKILL)
     if rule.action == "sigterm":
         os.kill(os.getpid(), _signal.SIGTERM)
-        return  # handler (if any) ran; the site continues
+        return False  # handler (if any) ran; the site continues
     raise rule.exc
 
 

@@ -21,10 +21,16 @@ Components:
 - `ServingFrontend` (frontend.py): submit/stream/cancel with deadlines,
   admission control (reject-with-reason, never crash), token callbacks.
 - `ServingMetrics` (metrics.py): TTFT/TPOT, queue depth, batch occupancy,
-  KV utilization, preemptions — published to `framework.monitor` and
-  rendered by `profiler.summary()`.
+  KV utilization, preemptions, shed/fault/restart counters — published
+  to `framework.monitor` and rendered by `profiler.summary()`.
+- fault tolerance (fault_tolerance.py): `AdmissionConfig` overload
+  shedding, the `EngineStepError` isolation boundary, `WatchdogConfig`
+  bounded engine restarts, typed `EngineStalled` — every submitted
+  request reaches a terminal status no matter what the engine does.
 """
 from .engine import EngineCore, MLPLMEngine
+from .fault_tolerance import (AdmissionConfig, EngineStalled,
+                              EngineStepError, WatchdogConfig)
 from .frontend import RequestHandle, ServingFrontend
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
@@ -32,8 +38,9 @@ from .spec import (DraftEngineProposer, NGramProposer, Proposer,
                    SpecDecodeConfig)
 
 __all__ = [
-    "DraftEngineProposer", "EngineCore", "MLPLMEngine", "NGramProposer",
-    "Proposer", "Request", "RequestHandle", "RequestStatus",
-    "SamplingParams", "Scheduler", "ServingFrontend", "ServingMetrics",
-    "SpecDecodeConfig",
+    "AdmissionConfig", "DraftEngineProposer", "EngineCore", "EngineStalled",
+    "EngineStepError", "MLPLMEngine", "NGramProposer", "Proposer",
+    "Request", "RequestHandle", "RequestStatus", "SamplingParams",
+    "Scheduler", "ServingFrontend", "ServingMetrics", "SpecDecodeConfig",
+    "WatchdogConfig",
 ]

@@ -18,6 +18,16 @@ Both must be shape-stable so the serving steady state never recompiles
 `monitor.inc("serving.prefill_retraces"/"serving.decode_retraces")` at
 TRACE time inside their jitted fns so tests can assert exactly that.
 
+Failure contract (docs/SERVING.md "Failure semantics"): an engine may
+raise from any entry point — the scheduler's typed fault boundary
+(`serving/fault_tolerance.py`) attributes the failure (raise
+`EngineStepError(phase, seq_ids=...)` to name the poisoned lane(s)
+directly; any other exception is attributed by per-lane probe replays),
+fails only the culpable request(s), and replays the survivors. Engines
+whose device state can be corrupted should be paired with an
+`engine_factory` (e.g. `MLPLMEngine.respawn`) so the watchdog can
+rebuild them.
+
 `MLPLMEngine` is the second, deliberately tiny implementation: a bag-of-
 embeddings MLP language model whose "KV" cache stores per-token embeddings
 in the same paged layout. It exists to prove the scheduler/frontend stack
@@ -160,6 +170,11 @@ class MLPLMEngine:
         import jax
         import jax.numpy as jnp
 
+        self._init_kwargs = dict(
+            vocab_size=vocab_size, hidden=hidden,
+            max_batch_size=max_batch_size, num_blocks=num_blocks,
+            block_size=block_size, max_blocks_per_seq=max_blocks_per_seq,
+            seed=seed)
         self.vocab_size = vocab_size
         self.max_batch_size = max_batch_size
         self.block_size = block_size
@@ -187,6 +202,12 @@ class MLPLMEngine:
         self._verify = jax.jit(
             functools.partial(_mlp_verify, block_size=block_size),
             donate_argnums=(1,))
+
+    def respawn(self) -> "MLPLMEngine":
+        """Build a fresh engine with IDENTICAL weights (seed-derived) and
+        an empty cache/pool — the watchdog `engine_factory` for this
+        engine class (`engine_factory=broken_engine.respawn`)."""
+        return MLPLMEngine(**self._init_kwargs)
 
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
                 lens: Optional[np.ndarray] = None) -> np.ndarray:

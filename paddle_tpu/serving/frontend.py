@@ -5,20 +5,26 @@ The user-facing surface of the serving subsystem (the role of the
 reference's serving C API, `paddle/fluid/inference/capi_exp/pd_inference_api.h`,
 minus the C): callers submit token prompts and get back a `RequestHandle`
 they can poll, stream, or cancel. Degradation is graceful by construction —
-over-capacity submissions come back REJECTED with a reason string, expired
-deadlines come back TIMED_OUT, and the engine itself never sees a request
-the cache cannot hold.
+over-capacity submissions come back REJECTED with a reason string,
+overload watermarks come back SHED in microseconds, expired deadlines come
+back TIMED_OUT, isolated engine faults come back FAILED, and the engine
+itself never sees a request the cache cannot hold. Every submitted
+request reaches a terminal status (docs/SERVING.md, "Failure semantics").
 
 The frontend is synchronously driven: `step()` advances the world one
-scheduling round; `stream()` and `run_until_idle()` drive it for you.
-Single-threaded by design — TPU serving wants one driver loop feeding the
-fixed-shape decode program, not a thread per request.
+scheduling round; `stream()` and `run_until_idle()` drive it for you —
+and both raise a typed `EngineStalled` (never spin) when the scheduler
+sustains `stall_after` consecutive zero-progress steps on a wedged
+engine. Single-threaded by design — TPU serving wants one driver loop
+feeding the fixed-shape decode program, not a thread per request.
 """
 from __future__ import annotations
 
 import time
 from typing import Iterator, List, Optional, Sequence
 
+from .fault_tolerance import (AdmissionConfig, EngineStalled,
+                              WatchdogConfig)
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
 
@@ -74,14 +80,37 @@ class ServingFrontend:
     def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
                  max_queue: int = 256,
                  default_timeout_s: Optional[float] = None,
-                 spec=None):
+                 spec=None,
+                 admission: Optional[AdmissionConfig] = None,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 engine_factory=None,
+                 stall_after: int = 512,
+                 clock=time.perf_counter):
         """`spec`: optional `SpecDecodeConfig` enabling speculative
         decoding (proposer + fixed draft length K) for every request
-        served through this frontend."""
+        served through this frontend.
+
+        `admission`: optional `AdmissionConfig` enabling overload load
+        shedding (watermarks + deadline-aware early rejection).
+        `watchdog` + `engine_factory`: optional `WatchdogConfig` enabling
+        stall detection and bounded engine restarts (the factory must
+        rebuild an identically-configured engine; a factory alone opts
+        into the default `WatchdogConfig` — it would otherwise never
+        run). `stall_after`: with
+        no watchdog, `run_until_idle`/`stream` raise `EngineStalled`
+        after this many consecutive zero-progress scheduler steps
+        instead of spinning on a wedged engine. `clock`: time source for
+        deadlines, latency stamps, and stall detection — shared with the
+        scheduler so fake-clock tests never mix time bases."""
         self.metrics = metrics or ServingMetrics()
+        self._clock = clock
         self.scheduler = Scheduler(engine, metrics=self.metrics,
-                                   max_queue=max_queue, spec=spec)
+                                   max_queue=max_queue, spec=spec,
+                                   admission=admission, watchdog=watchdog,
+                                   engine_factory=engine_factory,
+                                   clock=clock)
         self.default_timeout_s = default_timeout_s
+        self.stall_after = stall_after
 
     # ---- request API ----
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 16,
@@ -91,9 +120,10 @@ class ServingFrontend:
                stream_cb=None, seed: int = 0) -> RequestHandle:
         """Enqueue a generation request. NEVER raises on load conditions:
         a request that cannot be served comes back already-terminal with
-        `finish_reason` in {prompt_too_long, queue_full, empty_prompt}."""
+        `finish_reason` in {prompt_too_long, queue_full, empty_prompt}
+        (REJECTED) or a watermark/deadline reason (SHED)."""
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
-        now = time.perf_counter()
+        now = self._clock()
         deadline = None if timeout_s is None else now + timeout_s
         sp = SamplingParams(max_new_tokens=max_new_tokens,
                             temperature=temperature, top_k=top_k,
@@ -114,14 +144,33 @@ class ServingFrontend:
         """Advance one scheduling round; returns tokens produced."""
         return self.scheduler.step()
 
+    def _check_stalled(self):
+        sch = self.scheduler
+        if sch.watchdog_active:
+            # the watchdog owns stall recovery (restart, then typed
+            # failure on budget exhaustion); raising here on a tighter
+            # stall_after would preempt the restart the caller configured
+            return
+        if self.stall_after and not sch.idle \
+                and sch.zero_progress_steps >= self.stall_after:
+            mgr = sch.engine.manager
+            raise EngineStalled(
+                sch.zero_progress_steps,
+                f"running={sch.num_running} queued={len(sch.waiting)} "
+                f"free_blocks={mgr.free_blocks}/{mgr.num_blocks}")
+
     def run_until_idle(self, max_steps: int = 100000) -> int:
         """Drive until every submitted request is terminal. Returns steps
-        taken. `max_steps` bounds runaway loops (a bug, not a load
-        condition — so it raises)."""
+        taken. A wedged engine raises `EngineStalled` after
+        `stall_after` zero-progress steps (the watchdog, when installed,
+        restarts the engine first and only ends up here once its budget
+        is gone and every request was failed typed); `max_steps` bounds
+        runaway loops (a bug, not a load condition — so it raises)."""
         for n in range(max_steps):
             if self.scheduler.idle:
                 return n
             self.step()
+            self._check_stalled()
         if not self.scheduler.idle:
             raise RuntimeError(f"not idle after {max_steps} steps")
         return max_steps
@@ -140,6 +189,7 @@ class ServingFrontend:
             if handle.finished:
                 return
             self.step()
+            self._check_stalled()
         raise RuntimeError(f"stream not finished after {max_steps} steps")
 
     def summary(self) -> dict:

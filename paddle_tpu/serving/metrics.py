@@ -75,8 +75,48 @@ class ServingMetrics:
         monitor.inc("serving.requests_rejected")
         monitor.inc(f"serving.rejected.{reason}")
 
+    def on_shed(self, reason: str):
+        """Overload shed at admission (status SHED): the request was
+        structurally servable but the watermark/deadline admission
+        control turned it away in microseconds instead of letting it
+        collapse every admitted request's latency."""
+        monitor.inc("serving.shed_total")
+        monitor.inc(f"serving.shed.{reason}")
+
+    @staticmethod
+    def shed_by_reason() -> dict:
+        """Non-zero shed counts keyed by reason — the one owner of the
+        `serving.shed.<reason>` counter namespace (profiler summary and
+        bench extras both render this)."""
+        return {k[len("serving.shed."):]: v
+                for k, v in monitor.get_all().items()
+                if k.startswith("serving.shed.") and v}
+
     def on_preempt(self):
         monitor.inc("serving.preemptions")
+
+    # ---- fault tolerance ----
+    def on_isolated_fault(self, phase: str):
+        """One request failed by the fault-isolation boundary (NaN lane,
+        targeted `EngineStepError`, cache fault, failed probe replay) —
+        the surviving lanes kept serving."""
+        monitor.inc("serving.isolated_faults")
+        monitor.inc(f"serving.isolated_faults.{phase}")
+
+    def on_step_fault(self, phase: str):
+        """One UNattributed (transient) dispatch fault: nothing
+        committed, no lane culpable; the whole step replays next round."""
+        monitor.inc("serving.step_faults")
+        monitor.inc(f"serving.step_faults.{phase}")
+
+    def on_stall(self):
+        monitor.inc("serving.stall_detections")
+
+    def on_engine_restart(self, reason: str):
+        monitor.inc("serving.engine_restarts")
+        # reasons carry a phase suffix (step_faults:decode) — keep the
+        # leading class so the counter space stays bounded
+        monitor.inc(f"serving.engine_restarts.{reason.split(':', 1)[0]}")
 
     def on_prefill(self, num_tokens: int):
         monitor.inc("serving.prefills")
@@ -92,7 +132,8 @@ class ServingMetrics:
 
         name = {RequestStatus.FINISHED: "serving.requests_completed",
                 RequestStatus.CANCELLED: "serving.requests_cancelled",
-                RequestStatus.TIMED_OUT: "serving.requests_timed_out"}.get(
+                RequestStatus.TIMED_OUT: "serving.requests_timed_out",
+                RequestStatus.FAILED: "serving.requests_failed"}.get(
                     req.status)
         if name:
             monitor.inc(name)
@@ -152,9 +193,14 @@ class ServingMetrics:
         monitor.set_value("serving.queue_depth", queue_depth)
         monitor.set_max("serving.queue_depth_peak", queue_depth)
 
-    def gauge_queue(self, depth: int):
+    def gauge_queue(self, depth: int, queued_cost: Optional[int] = None):
         monitor.set_value("serving.queue_depth", depth)
         monitor.set_max("serving.queue_depth_peak", depth)
+        if queued_cost is not None:
+            # max_new_tokens-weighted backlog: what the cost watermark
+            # and the deadline-shed estimate actually latch on
+            monitor.set_value("serving.queued_cost", queued_cost)
+            monitor.set_max("serving.queued_cost_peak", queued_cost)
 
     def _publish_latency(self):
         for name, val in (("serving.ttft_p50_ms", _pct(self.ttft_s, 50)),

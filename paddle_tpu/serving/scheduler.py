@@ -10,6 +10,10 @@ Policy (documented in docs/SERVING.md):
 - admission: FIFO from the waiting queue into free slots; a request is
   admitted when its (bucket-padded) prompt allocation succeeds. Pool
   exhaustion (`KVCacheExhausted`) leaves it queued — never crashes.
+- load shedding (optional `AdmissionConfig`): watermark latches with
+  hysteresis over queue depth, queued `max_new_tokens` cost, and KV
+  utilization, plus deadline-aware early shedding — overload degrades to
+  fast SHED responses instead of collapsing TTFT for everyone.
 - prefill: per-request, prompt right-padded to a power-of-two bucket so
   prefill compiles O(log max_seq) programs; surplus padding blocks are
   returned via `BlockCacheManager.trim` right after.
@@ -21,6 +25,20 @@ Policy (documented in docs/SERVING.md):
   immediately; the slot admits a new request on the same step.
 - padding: empty slots decode with ctx_len=1 against a dedicated guard
   block (never a sequence's block), so padded lanes can't corrupt live KV.
+- fault isolation: every engine dispatch runs behind a typed boundary
+  (`serving/fault_tolerance.py`). Faults attributable to specific lanes
+  (NaN logits, typed `EngineStepError(seq_ids=...)`, cache failures,
+  failed probe replays) fail ONLY those requests; survivors roll back to
+  their pre-step cache lengths and replay next round with identical
+  tokens. Unattributed faults retry under a bounded budget, then
+  escalate to the watchdog.
+- watchdog (optional `WatchdogConfig` + `engine_factory`): stall
+  detection (per-dispatch wall clock + zero-progress rounds) drives a
+  bounded-restart supervisor — in-flight sequences re-queue with
+  tokens-so-far intact, the engine is rebuilt, the guard block is
+  re-leased from the fresh pool. Budget exhaustion fails every
+  non-terminal request typed (`engine_unrecoverable:*`): no request is
+  ever lost silently.
 - speculative decoding (optional, `SpecDecodeConfig`): each round a
   proposer drafts up to K tokens per lane; ONE fixed-shape
   `engine.verify_step` scores all lanes' pending+draft tokens at once;
@@ -42,9 +60,13 @@ from typing import Callable, Deque, List, Optional
 
 import numpy as np
 
+from ..framework.retry import Budget, retry_call
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
 from ..ops.sampling import sample_tokens
+from ..resilience import faults as _faults
 from .engine import EngineCore
+from .fault_tolerance import (AdmissionConfig, EngineStepError,
+                              OverloadController, WatchdogConfig)
 from .metrics import ServingMetrics
 from .spec import SpecDecodeConfig
 
@@ -73,12 +95,15 @@ class RequestStatus(enum.Enum):
     FINISHED = "finished"
     CANCELLED = "cancelled"
     REJECTED = "rejected"
+    SHED = "shed"               # overload admission control turned it away
+    FAILED = "failed"           # engine fault isolated to this request
     TIMED_OUT = "timed_out"
 
     @property
     def terminal(self) -> bool:
         return self in (RequestStatus.FINISHED, RequestStatus.CANCELLED,
-                        RequestStatus.REJECTED, RequestStatus.TIMED_OUT)
+                        RequestStatus.REJECTED, RequestStatus.SHED,
+                        RequestStatus.FAILED, RequestStatus.TIMED_OUT)
 
 
 class Request:
@@ -92,7 +117,7 @@ class Request:
         self.req_id = next(Request._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.sampling = sampling or SamplingParams()
-        self.deadline = deadline              # absolute perf_counter time
+        self.deadline = deadline              # absolute, scheduler's clock
         self.stream_cb = stream_cb
         self.generated: List[int] = []
         self.status = RequestStatus.QUEUED
@@ -107,6 +132,12 @@ class Request:
     @property
     def seq_id(self) -> int:
         return self.req_id
+
+    @property
+    def cost(self) -> int:
+        """Admission-control weight: decode steps this request may still
+        consume (`max_new_tokens` less what it already produced)."""
+        return max(1, self.sampling.max_new_tokens - len(self.generated))
 
     def context_tokens(self) -> np.ndarray:
         """Tokens whose KV must be in-cache before the next decode: the
@@ -142,20 +173,54 @@ class Scheduler:
     def __init__(self, engine: EngineCore,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: int = 256,
-                 spec: Optional[SpecDecodeConfig] = None):
+                 spec: Optional[SpecDecodeConfig] = None,
+                 admission: Optional[AdmissionConfig] = None,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 engine_factory: Optional[Callable[[], EngineCore]] = None,
+                 nan_checks: bool = True,
+                 clock: Callable[[], float] = time.perf_counter):
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
         self.max_queue = max_queue
         self.spec = spec
+        self.engine_factory = engine_factory
+        self.nan_checks = nan_checks
+        self._overload = OverloadController(admission) if admission else None
+        if watchdog is None and engine_factory is not None:
+            # a factory without a config opts into the default watchdog —
+            # otherwise the restart budget would be 0 and the caller's
+            # factory would silently never run
+            watchdog = WatchdogConfig()
+        self._wd = watchdog
+        self._restart_budget = Budget(
+            watchdog.max_restarts if watchdog is not None else 0)
+        self._clock = clock
         self.slots: List[Optional[Request]] = [None] * engine.max_batch_size
         self.waiting: Deque[Request] = deque()
+        self._queued_cost = 0          # sum of waiting requests' .cost
         self._admit_counter = itertools.count()
-        mgr = engine.manager
-        # Guard block for padded decode lanes: empty slots point their block
-        # table at this block (ctx_len=1), so the decode write for a padded
-        # lane lands here, never in a live sequence's block. Negative ids
-        # keep it out of the request id space; probe downward in case
-        # another scheduler already leases -1 on a shared engine.
+        # recent decode/verify dispatch wall times; the deadline-shed
+        # estimate uses the MEDIAN, which a compile-time outlier (first
+        # trace ~100x a steady step) cannot drag the way an EMA can
+        self._tpot_samples: Deque[float] = deque(maxlen=32)
+        self._zero_progress = 0        # consecutive no-progress steps
+        self._finish_events = 0        # terminal transitions, monotonic
+        self._step_faults = 0          # consecutive unattributed faults
+        self._pending_stall: Optional[str] = None
+        self._broken: Optional[str] = None   # rebind failed mid-restart
+        self._finite_fn = None               # jitted NaN screen, lazy
+        self._last_decode_dt: Optional[float] = None
+        self._bind_manager(engine.manager)
+
+    def _bind_manager(self, mgr):
+        """(Re)lease the guard block and derive pool geometry — on
+        construction and again after every watchdog engine rebuild."""
+        # Guard block for padded decode lanes: empty slots point their
+        # block table at this block (ctx_len=1), so the decode write for
+        # a padded lane lands here, never in a live sequence's block.
+        # Negative ids keep it out of the request id space; probe
+        # downward in case another scheduler already leases -1 on a
+        # shared engine.
         pad_id = _PAD_SEQ_ID
         while True:
             try:
@@ -163,6 +228,7 @@ class Scheduler:
                 break
             except ValueError:
                 pad_id -= 1
+        self._pad_seq_id = pad_id
         # What one sequence can ever hold: pool minus the guard (and minus
         # blocks other users of a shared engine already lease).
         self._usable_blocks = min(mgr.free_blocks, mgr.max_blocks_per_seq)
@@ -171,39 +237,82 @@ class Scheduler:
         while self._buckets[-1] < max_tokens:
             self._buckets.append(min(self._buckets[-1] * 2, max_tokens))
 
+    # ---- waiting-queue bookkeeping (cost-accounted) ----
+    def _queue_push(self, req: Request, front: bool = False):
+        if front:
+            self.waiting.appendleft(req)
+        else:
+            self.waiting.append(req)
+        self._queued_cost += req.cost
+        self.metrics.gauge_queue(len(self.waiting), self._queued_cost)
+
+    def _queue_pop(self) -> Request:
+        req = self.waiting.popleft()
+        self._queued_cost = max(0, self._queued_cost - req.cost)
+        self.metrics.gauge_queue(len(self.waiting), self._queued_cost)
+        return req
+
+    def _queue_remove(self, req: Request):
+        self.waiting.remove(req)
+        self._queued_cost = max(0, self._queued_cost - req.cost)
+        self.metrics.gauge_queue(len(self.waiting), self._queued_cost)
+
     # ---- submission / cancellation ----
     def submit(self, req: Request, now: Optional[float] = None) -> Request:
-        """Admission control. Rejects (with `finish_reason`) instead of
-        raising: over-long prompts and a full queue are load conditions,
-        not bugs."""
-        now = time.perf_counter() if now is None else now
+        """Admission control. Rejects/sheds (with `finish_reason`)
+        instead of raising: over-long prompts, a full queue, and
+        overload watermarks are load conditions, not bugs."""
+        now = self._clock() if now is None else now
         req.t_submit = now
         self.metrics.on_submit()
+        if self._broken is not None:
+            return self._reject(req, self._broken)
         mgr = self.engine.manager
         if len(req.prompt) == 0:
             return self._reject(req, "empty_prompt")
         # +1: the sequence must be able to hold at least one generated token
         if mgr.blocks_needed(len(req.prompt) + 1) > self._usable_blocks:
             return self._reject(req, "prompt_too_long")
+        if self._overload is not None:
+            cfg = self._overload.cfg
+            # the TPOT median only feeds the deadline estimate — don't
+            # pay the numpy call on every no-deadline submit
+            tpot = (self.tpot_estimate()
+                    if cfg.deadline_aware and req.deadline is not None
+                    else None)
+            reason = self._overload.shed_reason(
+                queue_depth=len(self.waiting),
+                queued_cost=self._queued_cost,
+                req_cost=req.cost,
+                kv_utilization=mgr.utilization(),
+                deadline=req.deadline, now=now,
+                tpot_s=tpot, lanes=len(self.slots))
+            if reason is not None:
+                return self._shed(req, reason)
         if len(self.waiting) >= self.max_queue:
             return self._reject(req, "queue_full")
-        self.waiting.append(req)
-        self.metrics.gauge_queue(len(self.waiting))
+        self._queue_push(req)
         return req
 
     def _reject(self, req: Request, reason: str) -> Request:
         req.status = RequestStatus.REJECTED
         req.finish_reason = reason
-        req.t_finish = time.perf_counter()
+        req.t_finish = self._clock()
         self.metrics.on_reject(reason)
+        return req
+
+    def _shed(self, req: Request, reason: str) -> Request:
+        req.status = RequestStatus.SHED
+        req.finish_reason = reason
+        req.t_finish = self._clock()
+        self.metrics.on_shed(reason)
         return req
 
     def cancel(self, req: Request) -> bool:
         if req.status.terminal:
             return False
         if req in self.waiting:
-            self.waiting.remove(req)
-            self.metrics.gauge_queue(len(self.waiting))
+            self._queue_remove(req)
             self._finish(req, RequestStatus.CANCELLED, "cancelled",
                          in_slot=False)
             return True
@@ -219,10 +328,24 @@ class Scheduler:
         """One scheduling round: expire deadlines, admit into free slots,
         run one fixed-shape decode over the occupied slots. Returns the
         number of tokens produced this step."""
-        now = time.perf_counter() if now is None else now
+        now = self._clock() if now is None else now
+        finish_mark = self._finish_events
         self._expire(now)
-        self._admit(now)
+        admitted = self._admit(now)
         produced = self._decode(now)
+        # progress = tokens, admissions, or terminal transitions; a
+        # non-idle scheduler sustaining zero progress is wedged — the
+        # watchdog's restart trigger and `EngineStalled`'s evidence
+        if produced > 0 or admitted > 0 or self._finish_events > finish_mark:
+            self._zero_progress = 0
+        else:
+            self._zero_progress += 1
+        if self._pending_stall is not None:
+            reason, self._pending_stall = self._pending_stall, None
+            self._stall(reason)
+        elif (self._wd is not None and not self.idle
+                and self._zero_progress >= self._wd.stall_steps):
+            self._stall("zero_progress")
         mgr = self.engine.manager
         # occupancy = decoded lanes / total lanes for THIS step (finished
         # sequences were already evicted, so num_running undercounts)
@@ -241,14 +364,227 @@ class Scheduler:
     def idle(self) -> bool:
         return self.num_running == 0 and not self.waiting
 
+    @property
+    def zero_progress_steps(self) -> int:
+        """Consecutive steps with no token, admission, or finish — the
+        frontend raises `EngineStalled` off this when no watchdog runs."""
+        return self._zero_progress
+
+    @property
+    def engine_restarts_remaining(self) -> int:
+        return self._restart_budget.remaining
+
+    @property
+    def watchdog_active(self) -> bool:
+        """True when a watchdog owns stall recovery — the frontend's
+        `stall_after` fallback must stand down, or a tight setting would
+        raise `EngineStalled` before the configured restart ever fires
+        (stranding requests non-terminal with a live engine_factory)."""
+        return self._wd is not None
+
+    def tpot_estimate(self) -> Optional[float]:
+        """Median recent decode-dispatch wall time (s), or None before
+        the first timed dispatch — what deadline-aware shedding prices a
+        queued token at."""
+        if not self._tpot_samples:
+            return None
+        return float(np.median(np.asarray(self._tpot_samples)))
+
+    def kv_leaked_blocks(self) -> int:
+        """Blocks leased in the manager that belong to neither the guard
+        nor a running sequence — must be 0 for a sole-tenant scheduler
+        (asserted by the chaos smoke after every injected fault)."""
+        mgr = self.engine.manager
+        held = mgr.num_blocks - mgr.free_blocks
+        legit = mgr.seq_blocks(self._pad_seq_id)
+        for r in self.slots:
+            if r is not None:
+                legit += mgr.seq_blocks(r.seq_id)
+        return held - legit
+
+    # ---- fault boundary ----
+    def _dispatch(self, phase: str, fn, *args):
+        """One engine dispatch behind the typed fault boundary: the
+        `serve.<phase>` injection site fires here, the wall clock feeds
+        the TPOT estimate + watchdog stall detection, and a `"flag"`
+        injection asks the caller to poison one lane (NaN path).
+        Returns (result, flagged)."""
+        flagged = _faults.check_flag(f"serve.{phase}")
+        t0 = self._clock()
+        try:
+            out = fn(*args)
+        finally:
+            dt = self._clock() - t0
+            if self._wd is not None and dt > self._wd.stall_timeout_s:
+                self.metrics.on_stall()
+                self._pending_stall = f"step_timeout:{phase}"
+        if phase in ("decode", "verify"):
+            # successful dispatches only: a burst of fast-failing
+            # dispatches would otherwise drag the median toward zero and
+            # silently disable deadline-aware shedding exactly while the
+            # engine is unhealthy. The caller converts it to a per-token
+            # price once it knows how many tokens the round committed
+            # (a verify dispatch commits up to K+1 per lane).
+            self._last_decode_dt = dt
+        return out, flagged
+
+    def _record_tpot(self, n_lanes: int, produced: int):
+        """Price the last decode/verify dispatch per lane-token: a round
+        that committed `produced` tokens across `n_lanes` lanes costs
+        `dt / (produced / n_lanes)` seconds per token. Plain decode
+        (1 token/lane) reduces to the raw dispatch time; pricing a
+        speculative verify at its raw time would overstate the per-token
+        cost ~K-fold and deadline-shed requests that are easily on time."""
+        if produced > 0 and self._last_decode_dt is not None:
+            self._tpot_samples.append(
+                self._last_decode_dt * n_lanes / produced)
+
+    def _finite_rows(self, logits) -> np.ndarray:
+        """Row-finiteness mask reduced ON DEVICE (`[..., V] -> [...]`
+        bool): the per-step NaN screen must not materialize the full
+        logits on host — at a realistic vocab that is a multi-MB D2H
+        copy per decode step, taxing exactly the hot path the fused
+        sampler keeps device-resident. One trace per logits rank, cached
+        for the scheduler's lifetime."""
+        import jax
+
+        if self._finite_fn is None:
+            import jax.numpy as jnp
+            self._finite_fn = jax.jit(
+                lambda x: jnp.isfinite(x).all(axis=-1))
+        return np.asarray(self._finite_fn(logits))
+
+    def _isolated(self, req: Request, reason: str, phase: str,
+                  slot: Optional[int] = None, in_slot: bool = True):
+        """Fail ONE request at the fault boundary; everyone else keeps
+        serving."""
+        self.metrics.on_isolated_fault(phase)
+        self._finish(req, RequestStatus.FAILED, reason, slot=slot,
+                     in_slot=in_slot)
+
+    def _step_fault(self, phase: str, exc: BaseException, lanes,
+                    probe=None, rollback=None):
+        """A whole-batch dispatch raised. Attribute it: typed
+        `EngineStepError.seq_ids` are trusted; otherwise each lane is
+        replayed alone (`probe`) and lanes that raise or return
+        non-finite rows are culpable. Culpable requests fail; survivors
+        roll back their cache bookkeeping (`rollback`) and replay next
+        round — deterministically, since decode KV writes are
+        position-indexed and idempotent. No culprit = transient: retried
+        under `step_retries`, then escalated to the watchdog."""
+        lanes = [(i, r) for i, r in lanes if self.slots[i] is r]
+        culpable = []
+        if isinstance(exc, EngineStepError) and exc.seq_ids:
+            ids = set(exc.seq_ids)
+            culpable = [(i, r) for i, r in lanes if r.seq_id in ids]
+        elif probe is not None and not isinstance(exc, _faults.InjectedFault):
+            # an untargeted injected fault models a transient dispatch
+            # failure — probing real hardware state would find nothing
+            for i, r in lanes:
+                try:
+                    row = probe(i, r)
+                    bad = not np.isfinite(np.asarray(row)).all()
+                except Exception:
+                    bad = True
+                if bad:
+                    culpable.append((i, r))
+        culp_ids = {r.seq_id for _, r in culpable}
+        if rollback is not None:
+            rollback([(i, r) for i, r in lanes if r.seq_id not in culp_ids])
+        for i, r in culpable:
+            self._isolated(r, f"engine_fault:{phase}", phase, slot=i)
+        if culpable:
+            self._step_faults = 0
+            return
+        self._step_faults += 1
+        self.metrics.on_step_fault(phase)
+        limit = self._wd.step_retries if self._wd is not None else 3
+        if self._step_faults > limit:
+            self._step_faults = 0
+            self._restart_engine(f"step_faults:{phase}")
+
+    def _stall(self, reason: str):
+        if reason == "zero_progress":
+            self.metrics.on_stall()
+        self._zero_progress = 0
+        self._restart_engine(reason)
+
+    def _restart_engine(self, reason: str) -> bool:
+        """Bounded-restart supervisor: re-queue every in-flight sequence
+        with tokens-so-far intact (preemption semantics — re-prefill on
+        re-admission is token-deterministic), rebuild the engine through
+        the factory, re-lease the guard block from the fresh pool. Out
+        of budget (or no factory): fail every non-terminal request typed
+        — the terminal-status contract over a dead engine."""
+        # a restart resolves any stall recorded for the dispatch that
+        # triggered it — without this, a dispatch that is both slow and
+        # raising would burn TWO budget units (escalation restart, then
+        # the stale pending stall restarting the fresh engine)
+        self._pending_stall = None
+        if self.engine_factory is None or not self._restart_budget.spend():
+            self._fail_all(f"engine_unrecoverable:{reason}")
+            return False
+        mgr = self.engine.manager
+        running = sorted(((r._admit_seq, i, r)
+                          for i, r in enumerate(self.slots) if r is not None),
+                         reverse=True)
+        for _, i, req in running:   # newest first -> oldest ends at front
+            self.slots[i] = None
+            try:
+                mgr.free(req.seq_id)
+            except KeyError:
+                pass
+            self._release_spec(req)
+            req.status = RequestStatus.PREEMPTED
+            req.num_preemptions += 1
+            self._queue_push(req, front=True)
+            self.metrics.on_preempt()
+        try:
+            engine = retry_call(
+                self.engine_factory,
+                retries=self._wd.rebuild_retries if self._wd else 1,
+                retry_on=(Exception,), base_delay=0.0, jitter=0.0,
+                sleep=lambda _s: None,
+                monitor_name="serving.engine_rebuild_retries")
+            self.engine = engine
+            # the rebind runs the serve.cache chaos site (guard-block
+            # allocate) — it MUST stay inside this boundary, or a cache
+            # fault here escapes step() and strands the re-queued
+            # requests non-terminal
+            self._bind_manager(engine.manager)
+        except Exception:
+            # a failed rebind can leave a stale guard-block id pointing
+            # into the fresh pool (where it is free, so a real sequence
+            # could lease it and pad writes would corrupt it): this
+            # scheduler must not serve again
+            self._broken = f"engine_rebuild_failed:{reason}"
+            self._fail_all(self._broken)
+            return False
+        self._step_faults = 0
+        self._zero_progress = 0
+        # the old window priced tokens at the DEAD engine's dispatch
+        # times — keeping it would deadline-shed requests the fresh
+        # engine can easily serve
+        self._tpot_samples.clear()
+        self._last_decode_dt = None
+        self.metrics.on_engine_restart(reason)
+        return True
+
+    def _fail_all(self, reason: str):
+        for i, req in enumerate(self.slots):
+            if req is not None:
+                self._finish(req, RequestStatus.FAILED, reason, slot=i)
+        while self.waiting:
+            req = self._queue_pop()
+            self._finish(req, RequestStatus.FAILED, reason, in_slot=False)
+
     # ---- phases ----
     def _expire(self, now: float):
         for req in [r for r in self.waiting
                     if r.deadline is not None and now > r.deadline]:
-            self.waiting.remove(req)
+            self._queue_remove(req)
             self._finish(req, RequestStatus.TIMED_OUT, "deadline_in_queue",
                          in_slot=False)
-        self.metrics.gauge_queue(len(self.waiting))
         for i, req in enumerate(self.slots):
             if req is not None and req.deadline is not None \
                     and now > req.deadline:
@@ -261,55 +597,95 @@ class Scheduler:
                 return b
         return self._buckets[-1]
 
-    def _admit(self, now: float):
+    def _admit_allocate(self, req: Request, n_ctx: int) -> Optional[int]:
+        """Lease KV for an admission: bucket-padded first, unpadded when
+        the padding overshot (the per-seq cap, or a pool with no runners
+        left to free blocks). Returns the allocated length, or None for
+        a plain pool wait (runners will free blocks — stay queued).
+        Injected/corrupt cache state propagates to the caller's single
+        fault handler."""
         mgr = self.engine.manager
+        try:
+            bucket = self._bucket(n_ctx)
+            mgr.allocate(req.seq_id, bucket)
+            return bucket
+        except (KVCacheExhausted, SequenceTooLong) as e:
+            if isinstance(e, KVCacheExhausted) and self.num_running > 0:
+                return None
+            try:
+                mgr.allocate(req.seq_id, n_ctx)
+                return n_ctx
+            except (KVCacheExhausted, SequenceTooLong):
+                return None
+
+    def _admit(self, now: float) -> int:
+        mgr = self.engine.manager
+        admitted = 0
         while self.waiting and None in self.slots:
             req = self.waiting[0]
             ctx = req.context_tokens()
-            bucket = self._bucket(len(ctx))
             try:
-                mgr.allocate(req.seq_id, bucket)
-            except (KVCacheExhausted, SequenceTooLong) as e:
-                # Bucket padding overshot (the per-seq cap, or a pool with
-                # no runners left to free blocks): retry unpadded. A plain
-                # pool wait (runners will free blocks) stays queued.
-                if isinstance(e, KVCacheExhausted) and self.num_running > 0:
-                    break
-                try:
-                    mgr.allocate(req.seq_id, len(ctx))
-                    bucket = len(ctx)
-                except (KVCacheExhausted, SequenceTooLong):
-                    break
-            self.waiting.popleft()
+                bucket = self._admit_allocate(req, len(ctx))
+            except Exception:              # injected/corrupt cache state
+                self._queue_pop()
+                self._isolated(req, "engine_fault:cache", "cache",
+                               in_slot=False)
+                continue
+            if bucket is None:
+                break
+            self._queue_pop()
             slot = self.slots.index(None)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :len(ctx)] = ctx
             tables = mgr.block_table_array([req.seq_id])
             from ..profiler import RecordEvent
 
-            with RecordEvent("serving.prefill"):
-                logits = self.engine.prefill(
-                    padded, tables, lens=np.asarray([len(ctx)], np.int32))
+            try:
+                with RecordEvent("serving.prefill"):
+                    logits, flagged = self._dispatch(
+                        "prefill", self.engine.prefill, padded, tables,
+                        np.asarray([len(ctx)], np.int32))
+            except Exception:
+                # prefill is per-request: attribution is trivial
+                mgr.free(req.seq_id)
+                self._isolated(req, "engine_fault:prefill", "prefill",
+                               in_slot=False)
+                continue
+            if flagged or (self.nan_checks
+                           and not bool(self._finite_rows(logits)[0])):
+                mgr.free(req.seq_id)
+                self._isolated(req, "nan_logits", "prefill",
+                               in_slot=False)
+                continue
             mgr.trim(req.seq_id, len(ctx))
             self.metrics.on_prefill(len(ctx))
             was_preempted = req.status is RequestStatus.PREEMPTED
             req.status = RequestStatus.RUNNING
             req._admit_seq = next(self._admit_counter)
             self.slots[slot] = req
+            admitted += 1
             if not was_preempted:
-                tok = int(sample_tokens(logits, *self._sampling_arrays(
-                    [req]))[0])
+                try:
+                    _faults.check("serve.sample")
+                    tok = int(sample_tokens(logits, *self._sampling_arrays(
+                        [req]))[0])
+                except Exception:
+                    # the request already owns its slot; single-request
+                    # commit point, so fail it and keep admitting
+                    self._isolated(req, "engine_fault:sample", "sample",
+                                   slot=slot)
+                    continue
                 req.generated.append(tok)
                 req._last = tok
                 if req.t_first_token is None:
-                    req.t_first_token = time.perf_counter()
+                    req.t_first_token = self._clock()
                     self.metrics.on_first_token(req)
                 if req.stream_cb is not None:
                     req.stream_cb(req, tok)
                 self._maybe_finish_on_token(req, tok, slot)
             # preempted re-admissions keep their pending `_last`; the
             # prefill logits above are for a token already sampled — drop.
-        self.metrics.gauge_queue(len(self.waiting))
+        return admitted
 
     @staticmethod
     def _sampling_arrays(reqs):
@@ -358,9 +734,8 @@ class Scheduler:
         self.slots[slot] = None
         req.status = RequestStatus.PREEMPTED
         req.num_preemptions += 1
-        self.waiting.appendleft(req)
+        self._queue_push(req, front=True)
         self.metrics.on_preempt()
-        self.metrics.gauge_queue(len(self.waiting))
         return True
 
     def _decode(self, now: float) -> int:
@@ -372,7 +747,14 @@ class Scheduler:
         # grow (and possibly preempt) before building the batch arrays
         grown = []
         for i, req in active:
-            if self.slots[i] is req and self._grow(req, i):
+            if self.slots[i] is not req:
+                continue
+            try:
+                ok = self._grow(req, i)
+            except Exception:              # injected/corrupt cache state:
+                self._isolated(req, "engine_fault:cache", "cache", slot=i)
+                continue                   # attribution is trivial
+            if ok:
                 grown.append((i, req))
         active = [(i, r) for i, r in grown if self.slots[i] is r]
         if not active:
@@ -389,16 +771,63 @@ class Scheduler:
             tables[i] = mgr.block_table_array([req.seq_id])[0]
         from ..profiler import RecordEvent
 
-        with RecordEvent("serving.decode_step"):
-            logits = self.engine.decode_step(tokens, lens, tables)
-        t_tok = time.perf_counter()
+        def probe(i, req):
+            """Replay ONE lane of the failed step (same fixed shapes, so
+            no recompile; its KV write is idempotent with the retry)."""
+            t = np.zeros((B,), np.int32)
+            t[i] = tokens[i]
+            ln = np.ones((B,), np.int32)
+            ln[i] = lens[i]
+            tb = np.full((B, mgr.max_blocks_per_seq), self._pad_block,
+                         np.int32)
+            tb[i] = tables[i]
+            return np.asarray(self.engine.decode_step(t, ln, tb))[i]
+
+        def rollback(survivors):
+            # undo this step's _grow so the next round replays cleanly
+            for i, r in survivors:
+                mgr.trim(r.seq_id, int(lens[i]) - 1)
+
+        try:
+            with RecordEvent("serving.decode_step"):
+                logits, flagged = self._dispatch(
+                    "decode", self.engine.decode_step, tokens, lens, tables)
+        except Exception as e:
+            self._step_fault("decode", e, active, probe=probe,
+                             rollback=rollback)
+            return 0
+        if flagged or self.nan_checks:
+            if flagged:              # injection path: poison one lane
+                arr = np.array(logits)
+                arr[active[0][0]] = np.nan
+                logits = arr
+                finite = np.isfinite(arr).all(axis=-1)
+            else:                    # hot path: [B] bool fetch only
+                finite = self._finite_rows(logits)
+            for i, req in active:
+                if not finite[i]:
+                    # the garbage KV went into this lane's own blocks;
+                    # freeing the sequence discards it
+                    self._isolated(req, "nan_logits", "decode", slot=i)
+            active = [(i, r) for i, r in active if self.slots[i] is r]
+            if not active:
+                return 0
+        t_tok = self._clock()
         # fused device sampling over ALL lanes (fixed [B, V] shape; padded
         # lanes sample greedy and are discarded)
         active_map = dict(active)
-        picked = sample_tokens(logits, *self._sampling_arrays(
-            [active_map.get(i) for i in range(B)]))
+        try:
+            _faults.check("serve.sample")
+            picked = sample_tokens(logits, *self._sampling_arrays(
+                [active_map.get(i) for i in range(B)]))
+        except Exception as e:
+            self._step_fault("sample", e, active, rollback=rollback)
+            return 0
+        self._step_faults = 0   # a full dispatch+sample round succeeded
         produced = 0
         for i, req in active:
+            if self.slots[i] is not req:   # cancelled by a stream_cb
+                continue                   # earlier in this very loop
             tok = int(picked[i])
             req.generated.append(tok)
             req._last = tok
@@ -409,6 +838,7 @@ class Scheduler:
             if req.stream_cb is not None:
                 req.stream_cb(req, tok)
             self._maybe_finish_on_token(req, tok, i)
+        self._record_tpot(len(active), produced)
         self.metrics.on_decode(produced)
         return produced
 
@@ -468,7 +898,11 @@ class Scheduler:
                     req.seq_id, req.all_tokens(), K))[:K]
             except Exception:
                 drafts = []          # proposers must never kill the step
-            got = self._grow_n(req, i, 1 + len(drafts))
+            try:
+                got = self._grow_n(req, i, 1 + len(drafts))
+            except Exception:        # injected/corrupt cache state
+                self._isolated(req, "engine_fault:cache", "cache", slot=i)
+                continue
             if got == 0:
                 continue
             lanes.append((i, req, drafts[:got - 1], pre_len))
@@ -491,6 +925,7 @@ class Scheduler:
             // mgr.block_size
         tables = np.full((B, width), self._pad_block, np.int32)
         lane_reqs: List[Optional[Request]] = [None] * B
+        pre_lens = {}
         for i, req, drafts, pre_len in lanes:
             tokens[i, 0] = req._last
             if drafts:
@@ -501,14 +936,60 @@ class Scheduler:
             tables[i, :mgr.max_blocks_per_seq] = mgr.block_table_array(
                 [req.seq_id], pad=self._pad_block)[0]
             lane_reqs[i] = req
+            pre_lens[req.seq_id] = pre_len
         from ..profiler import RecordEvent
 
-        with RecordEvent("serving.verify_step"):
-            logits = self.engine.verify_step(tokens, ctx, tables)
-        t_tok = time.perf_counter()
-        picked = sample_tokens(logits, *self._sampling_arrays(lane_reqs))
+        def probe(i, req):
+            t = np.zeros((B, S), np.int32)
+            t[i] = tokens[i]
+            c = np.full((B,), S, np.int32)
+            c[i] = ctx[i]
+            tb = np.full((B, width), self._pad_block, np.int32)
+            tb[i] = tables[i]
+            return np.asarray(self.engine.verify_step(t, c, tb))[i]
+
+        def rollback(survivors):
+            for i, r in survivors:
+                mgr.trim(r.seq_id, pre_lens[r.seq_id])
+
+        lane_pairs = [(i, r) for i, r, _d, _p in lanes]
+        try:
+            with RecordEvent("serving.verify_step"):
+                logits, flagged = self._dispatch(
+                    "verify", self.engine.verify_step, tokens, ctx, tables)
+        except Exception as e:
+            self._step_fault("verify", e, lane_pairs, probe=probe,
+                             rollback=rollback)
+            return 0
+        if flagged or self.nan_checks:
+            if flagged:              # injection path: poison one lane
+                arr = np.array(logits)
+                arr[lanes[0][0]] = np.nan
+                logits = arr
+                finite = np.isfinite(arr).all(axis=(-2, -1))
+            else:                    # hot path: [B, S] bool fetch only
+                finite = self._finite_rows(logits).all(axis=-1)
+            for i, req in lane_pairs:
+                if not finite[i]:
+                    self._isolated(req, "nan_logits", "verify", slot=i)
+                    lane_reqs[i] = None
+            lanes = [(i, r, d, p) for i, r, d, p in lanes
+                     if self.slots[i] is r]
+            if not lanes:
+                return 0
+        t_tok = self._clock()
+        try:
+            _faults.check("serve.sample")
+            picked = sample_tokens(logits, *self._sampling_arrays(lane_reqs))
+        except Exception as e:
+            self._step_fault("sample", e, [(i, r) for i, r, _d, _p in lanes],
+                             rollback=rollback)
+            return 0
+        self._step_faults = 0   # a full verify+sample round succeeded
         produced = proposed = accepted = 0
         for i, req, drafts, pre_len in lanes:
+            if self.slots[i] is not req:   # cancelled by a stream_cb
+                continue                   # earlier in this very loop
             a = 0
             while a < len(drafts) and drafts[a] == int(picked[i, a]):
                 a += 1
@@ -531,12 +1012,17 @@ class Scheduler:
             if not req.status.terminal:
                 # roll back rejected speculation: keep pending + accepted
                 mgr.trim(req.seq_id, pre_len + 1 + a)
+        self._record_tpot(len(lanes), produced)
         self.metrics.on_decode(produced)
         self.metrics.on_spec(proposed=proposed, accepted=accepted,
                              produced=produced, lanes=len(lanes))
         return produced
 
     def _maybe_finish_on_token(self, req: Request, tok: int, slot: int):
+        if req.status.terminal:
+            # a stream callback may cancel mid-commit (reentrancy): the
+            # slot and blocks are already released — don't finish twice
+            return
         sp = req.sampling
         if sp.eos_token_id is not None and tok == sp.eos_token_id:
             self._finish(req, RequestStatus.FINISHED, "eos", slot=slot)
@@ -554,7 +1040,8 @@ class Scheduler:
         self._release_spec(req)
         req.status = status
         req.finish_reason = reason
-        req.t_finish = time.perf_counter()
+        req.t_finish = self._clock()
+        self._finish_events += 1
         self.metrics.on_finish(req)
 
     def _release_spec(self, req: Request):

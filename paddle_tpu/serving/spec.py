@@ -205,9 +205,13 @@ class DraftEngineProposer:
                 props.append(int(np.argmax(logits[0])))
             mgr.trim(seq_id, n)
             return props
-        except (KVCacheExhausted, SequenceTooLong):
-            # draft pool pressure: propose nothing, drop our lease so the
-            # next call starts clean
+        except Exception:
+            # draft pool pressure (KVCacheExhausted/SequenceTooLong) — or
+            # ANY draft-engine fault: propose nothing and drop our lease
+            # so the next call starts clean. Catching only the cache
+            # types used to leak the lease + sync entry when the draft
+            # engine itself raised (the scheduler swallows the exception
+            # outside, where our lease is invisible).
             self.release(seq_id)
             return []
 

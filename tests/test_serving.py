@@ -397,6 +397,114 @@ class TestFrontend:
 
 
 # ---------------------------------------------------------------------------
+# Deadline / cancel races (the paths between "scheduled" and "committed")
+# ---------------------------------------------------------------------------
+
+class TestDeadlineCancelRaces:
+    def test_cancel_self_from_stream_cb_during_prefill(self):
+        """The first token is emitted from INSIDE the admission/prefill
+        phase; a callback cancelling its own request there must not
+        double-finish (the old `_maybe_finish_on_token` would free the
+        slot twice and KeyError on the manager)."""
+        eng = make_mlp_engine()
+        fe = ServingFrontend(eng)
+        h = None
+
+        def cb(tok):
+            assert fe.cancel(h)
+
+        h = fe.submit([1, 2, 3], max_new_tokens=5, stream_cb=cb)
+        fe.run_until_idle(max_steps=100)
+        assert h.status is RequestStatus.CANCELLED
+        assert len(h.tokens) == 1        # the prefill-sampled token
+        mgr = eng.manager
+        assert mgr.free_blocks == mgr.num_blocks - 1   # only the guard
+
+    def test_cancel_other_request_from_stream_cb_mid_batch(self):
+        """A callback cancelling a DIFFERENT in-flight request while the
+        decode commit loop is walking the batch: the cancelled lane's
+        token must not be committed onto a terminal request."""
+        eng = make_mlp_engine()
+        fe = ServingFrontend(eng)
+        handles = {}
+        fired = []
+
+        def cb(tok):
+            if not fired:
+                fired.append(True)
+                assert fe.cancel(handles["victim"])
+
+        killer = fe.submit([1, 2, 3], max_new_tokens=6, stream_cb=cb)
+        handles["victim"] = fe.submit([4, 5, 6], max_new_tokens=6)
+        fe.run_until_idle(max_steps=200)
+        assert killer.status is RequestStatus.FINISHED
+        assert len(killer.tokens) == 6
+        victim = handles["victim"]
+        assert victim.status is RequestStatus.CANCELLED
+        n_at_cancel = len(victim.tokens)
+        fe.run_until_idle(max_steps=50)
+        assert len(victim.tokens) == n_at_cancel   # nothing appended after
+        mgr = eng.manager
+        assert mgr.free_blocks == mgr.num_blocks - 1
+
+    def test_deadline_expires_mid_preemption(self):
+        """A PREEMPTED request (tokens-so-far kept, waiting at the queue
+        front) whose deadline lapses before re-admission must come back
+        TIMED_OUT with its partial tokens intact — and with no leaked
+        blocks (they were freed at preemption time)."""
+        ps = prompts(6, np.random.default_rng(1), lo=5, hi=8)
+        eng = make_mlp_engine(max_batch=4, num_blocks=10, block_size=4,
+                              max_blocks_per_seq=8)
+        fe = ServingFrontend(eng)
+        hs = [fe.submit(p, max_new_tokens=14) for p in ps]
+        victim = None
+        for _ in range(2000):
+            fe.step()
+            if victim is None:
+                pre = [h for h in hs
+                       if h.status is RequestStatus.PREEMPTED]
+                if pre:
+                    victim = pre[0]
+                    # expire it while it waits for re-admission
+                    victim._req.deadline = -1.0
+            if all(h.finished for h in hs):
+                break
+        assert victim is not None, "trace never preempted"
+        assert victim.status is RequestStatus.TIMED_OUT
+        assert victim.finish_reason == "deadline_in_queue"
+        assert victim.num_preemptions >= 1
+        assert len(victim.tokens) > 0          # partial output preserved
+        others = [h for h in hs if h is not victim]
+        assert all(h.status is RequestStatus.FINISHED for h in others)
+        assert all(len(h.tokens) == 14 for h in others)
+        mgr = eng.manager
+        assert mgr.free_blocks == mgr.num_blocks - 1
+
+    def test_shed_vs_admit_at_exact_watermark(self):
+        """Boundary contract through the frontend: depth == queue_high
+        sheds, the latch holds between the watermarks, and depth ==
+        queue_low re-admits."""
+        from paddle_tpu.serving import AdmissionConfig
+
+        eng = make_mlp_engine(max_batch=1, num_blocks=32)
+        fe = ServingFrontend(eng, admission=AdmissionConfig(queue_high=2,
+                                                            queue_low=1))
+        a = fe.submit([1, 2], max_new_tokens=8)    # depth 0 -> queued
+        b = fe.submit([1, 2], max_new_tokens=8)    # depth 1 -> queued
+        c = fe.submit([1, 2], max_new_tokens=8)    # depth == high: SHED
+        assert [a.status, b.status, c.status] == [
+            RequestStatus.QUEUED, RequestStatus.QUEUED, RequestStatus.SHED]
+        fe.step()                                  # admits a; depth 1
+        assert len(fe.scheduler.waiting) == 1
+        d = fe.submit([1, 2], max_new_tokens=8)    # depth == low: admitted
+        assert d.status is RequestStatus.QUEUED
+        e = fe.submit([1, 2], max_new_tokens=8)    # depth == high again
+        assert e.status is RequestStatus.SHED
+        fe.run_until_idle(max_steps=300)
+        assert all(h.status is RequestStatus.FINISHED for h in (a, b, d))
+
+
+# ---------------------------------------------------------------------------
 # Llama serving == Llama generate() (numeric fidelity of the serving path)
 # ---------------------------------------------------------------------------
 
