@@ -152,6 +152,32 @@ def _log_compile(kind, name, key):
         print(f"[paddle_tpu] compile {kind} op={name}")
 
 
+def _obs_trace_compile(cache, key, fn, kind, name):
+    """Observability hook on an executable-cache miss: record the compile
+    (with a retrace-cause diff against the nearest cached signature for
+    the same op) and time the FIRST call — trace+compile happen lazily
+    there. The wrapper swaps the raw jitted fn back into the cache after
+    that call, so steady-state dispatch pays nothing. No-op (returns `fn`
+    unwrapped) while observability is disabled — the cold compile path is
+    the only place this is even consulted."""
+    from .. import observability as _obs
+
+    if not _obs.enabled():
+        return fn
+    import time as _time
+
+    rec = _obs.compile_trace.on_compile(kind, name, key)
+
+    def first_call(*args, **kw):
+        t0 = _time.perf_counter()
+        out = fn(*args, **kw)
+        rec.wall_s = _time.perf_counter() - t0
+        cache[key] = fn
+        return out
+
+    return first_call
+
+
 def _evict(cache: dict):
     """Bound cache size to FLAGS_eager_cache_size (FIFO eviction)."""
     limit = flags.flag_value("eager_cache_size")
@@ -169,7 +195,8 @@ def _get_fwd(op: OpDef, attrs: dict, arrays) -> Callable:
         base = op.fn
         if attrs:
             base = functools.partial(base, **attrs)
-        fn = jax.jit(base)
+        fn = _obs_trace_compile(_fwd_cache, key, jax.jit(base), "fwd",
+                                op.name)
         _fwd_cache[key] = fn
     return fn
 
@@ -193,7 +220,8 @@ def _get_fwd_vjp(op: OpDef, attrs: dict, arrays, mask) -> Callable:
             out, vjp_fn = jax.vjp(lambda *xs: _base(*xs), *prims)
             return out, vjp_fn
 
-        fn = jax.jit(fwd)
+        fn = _obs_trace_compile(_fwd_vjp_cache, key, jax.jit(fwd),
+                                "fwd_vjp", op.name)
         _fwd_vjp_cache[key] = fn
     return fn
 
@@ -238,7 +266,8 @@ def _get_fwd_grad(op: OpDef, attrs: dict, arrays, mask, seed_slots,
             grads = tuple(g for g, m in zip(grads, _mask) if m)
             return outs, grads
 
-        fn = jax.jit(fwd_grad)
+        fn = _obs_trace_compile(_fwd_grad_cache, key, jax.jit(fwd_grad),
+                                "fwd_grad", op.name)
         _fwd_grad_cache[key] = fn
     return fn
 

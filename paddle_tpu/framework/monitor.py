@@ -1,4 +1,4 @@
-"""Global counter registry (reference `fluid/platform/monitor.h`:
+"""Global metrics registry (reference `fluid/platform/monitor.h`:
 DEFINE_INT_STATUS / StatRegistry).
 
 The reference exposes process-wide named integer counters that subsystems
@@ -7,23 +7,35 @@ scrapes. TPU-native equivalent: a plain Python registry; the PJRT runtime
 owns device allocation, so the built-in counters here track what the
 framework itself does (executable compiles, eager dispatches), and any
 subsystem can register its own.
+
+Typed surface (ISSUE 7 satellite): beyond monotonic/settable counters
+there are explicit **gauges** (`set_gauge`) and fixed-bucket
+**histograms** (`observe`); `snapshot()` flattens everything into one
+dict (histograms expand Prometheus-style into `_bucket{le=...}` /
+`_sum` / `_count` keys) and `render_prometheus()` emits the text
+exposition format (`tools/metrics_dump.py` is the CLI). The serving
+metrics module and every `profiler.summary()` section builder scrape
+through `snapshot()` instead of ad-hoc attribute walks.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Iterable, Optional, Sequence
 
 __all__ = ["register_counter", "counter", "inc", "set_value", "set_max",
-           "get", "get_all", "reset", "reset_all", "Counter"]
+           "set_gauge", "observe", "histogram", "get", "get_all",
+           "snapshot", "render_prometheus", "reset", "reset_prefix",
+           "reset_all", "Counter", "Histogram"]
 
 
 class Counter:
     """One named monotonic/settable counter (int or float)."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "_value", "_lock", "kind")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, kind: str = "counter"):
         self.name = name
+        self.kind = kind          # "counter" | "gauge" (prometheus TYPE)
         self._value = 0
         self._lock = threading.Lock()
 
@@ -50,16 +62,76 @@ class Counter:
         self.set(0)
 
 
+# Default latency-ish bucket bounds (seconds-agnostic: callers pick the
+# unit and keep it consistent per histogram name).
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram: cumulative bucket counts + sum + count.
+
+    Buckets are frozen at registration (first `observe`); re-registering
+    with different bounds is an error — tooling depends on stable bucket
+    layouts for rate() math."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str, buckets: Sequence[float]):
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)   # +inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        """Prometheus-flat view: cumulative `_bucket_le_*`, `_sum`,
+        `_count` keys."""
+        with self._lock:
+            counts = list(self._counts)
+            s, c = self._sum, self._count
+        out: Dict[str, float] = {}
+        cum = 0
+        for b, n in zip(self.buckets, counts[:-1]):
+            cum += n
+            out[f"{self.name}_bucket_le_{b:g}"] = cum
+        out[f"{self.name}_bucket_le_inf"] = cum + counts[-1]
+        out[f"{self.name}_sum"] = round(s, 6)
+        out[f"{self.name}_count"] = c
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+
 _registry: Dict[str, Counter] = {}
+_histograms: Dict[str, Histogram] = {}
 _registry_lock = threading.Lock()
 
 
-def register_counter(name: str) -> Counter:
+def register_counter(name: str, kind: str = "counter") -> Counter:
     """Idempotently register (or fetch) a counter by name."""
     with _registry_lock:
         c = _registry.get(name)
         if c is None:
-            c = _registry[name] = Counter(name)
+            c = _registry[name] = Counter(name, kind)
+        elif kind == "gauge":
+            c.kind = "gauge"   # explicit gauge declaration wins
         return c
 
 
@@ -75,8 +147,39 @@ def set_value(name: str, value):
     register_counter(name).set(value)
 
 
+def set_gauge(name: str, value):
+    """A value that can go up AND down (queue depth, utilization %):
+    typed so `render_prometheus` declares it `gauge`, not `counter`."""
+    register_counter(name, kind="gauge").set(value)
+
+
 def set_max(name: str, value):
     return register_counter(name).set_max(value)
+
+
+def histogram(name: str,
+              buckets: Optional[Iterable[float]] = None) -> Histogram:
+    """Fetch-or-register the histogram `name` (buckets frozen on first
+    registration; asking for DIFFERENT bounds afterwards raises — the
+    samples would silently land in a layout the caller never asked
+    for)."""
+    with _registry_lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = Histogram(
+                name, tuple(buckets) if buckets else _DEFAULT_BUCKETS)
+        elif buckets is not None and tuple(
+                sorted(float(b) for b in buckets)) != h.buckets:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}; cannot re-register with {tuple(buckets)}")
+        return h
+
+
+def observe(name: str, value: float,
+            buckets: Optional[Iterable[float]] = None):
+    """Record one sample into the fixed-bucket histogram `name`."""
+    histogram(name, buckets).observe(value)
 
 
 def get(name: str):
@@ -90,14 +193,86 @@ def get_all() -> Dict[str, object]:
     return {k: c.get() for k, c in items}
 
 
+def snapshot(prefix: Optional[str] = None,
+             include_histograms: bool = True) -> Dict[str, object]:
+    """One flat dict of EVERYTHING: counters, gauges, and histograms
+    (expanded `_bucket_le_*`/`_sum`/`_count`; pass
+    ``include_histograms=False`` for the scalar-only slice). `prefix`
+    filters by name prefix — the one scrape surface serving metrics,
+    profiler summary sections, and `tools/metrics_dump.py` share."""
+    with _registry_lock:
+        counters = sorted(_registry.items())
+        hists = sorted(_histograms.items()) if include_histograms else []
+    out: Dict[str, object] = {}
+    for k, c in counters:
+        if prefix is None or k.startswith(prefix):
+            out[k] = c.get()
+    for k, h in hists:
+        if prefix is None or k.startswith(prefix):
+            out.update(h.snapshot())
+    return out
+
+
+def reset_prefix(prefix: str):
+    """Zero every counter AND histogram whose name starts with `prefix`
+    (tests, engine swap)."""
+    with _registry_lock:
+        counters = [c for k, c in _registry.items() if k.startswith(prefix)]
+        hists = [h for k, h in _histograms.items() if k.startswith(prefix)]
+    for c in counters:
+        c.reset()
+    for h in hists:
+        h.reset()
+
+
+def render_prometheus(prefix: Optional[str] = None) -> str:
+    """Prometheus text exposition (metric names sanitized to [a-zA-Z0-9_],
+    histogram buckets as proper `{le="..."}` labels)."""
+    with _registry_lock:
+        counters = sorted(_registry.items())
+        hists = sorted(_histograms.items())
+
+    def sane(name: str) -> str:
+        return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                       for ch in name)
+
+    lines = []
+    for k, c in counters:
+        if prefix is not None and not k.startswith(prefix):
+            continue
+        n = sane(k)
+        lines.append(f"# TYPE {n} {c.kind}")
+        lines.append(f"{n} {c.get()}")
+    for k, h in hists:
+        if prefix is not None and not k.startswith(prefix):
+            continue
+        n = sane(k)
+        lines.append(f"# TYPE {n} histogram")
+        snap = h.snapshot()
+        for b in h.buckets:
+            lines.append(f'{n}_bucket{{le="{b:g}"}} '
+                         f"{snap[f'{k}_bucket_le_{b:g}']}")
+        lines.append(f'{n}_bucket{{le="+Inf"}} '
+                     f"{snap[f'{k}_bucket_le_inf']}")
+        lines.append(f"{n}_sum {snap[f'{k}_sum']}")
+        lines.append(f"{n}_count {snap[f'{k}_count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def reset(name: str):
     c = _registry.get(name)
     if c is not None:
         c.reset()
+    h = _histograms.get(name)
+    if h is not None:
+        h.reset()
 
 
 def reset_all():
     with _registry_lock:
         counters = list(_registry.values())
+        hists = list(_histograms.values())
     for c in counters:
         c.reset()
+    for h in hists:
+        h.reset()

@@ -174,6 +174,16 @@ class LlamaInferenceEngine:
         self._verify = jax.jit(functools.partial(
             _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
 
+    def cost_card_args(self, phase: str):
+        """Observability hook (`observability.costs.ensure_engine_card`):
+        the jitted executable behind `phase` plus the leading arguments
+        the scheduler never sees (stacked params + paged KV). Lowered —
+        never executed — for `cost_analysis()`: compiler-reported FLOPs
+        per prefill/decode/verify dispatch."""
+        fn = {"prefill": self._prefill, "decode": self._decode,
+              "verify": self._verify}[phase]
+        return fn, (self.params, self.k_cache, self.v_cache)
+
     # ---- public API (the serving EngineCore surface) ----
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
                 lens: Optional[np.ndarray] = None):

@@ -1,0 +1,79 @@
+"""paddle_tpu.observability — the runtime's own perf/behavior evidence.
+
+Three PRs of serving work shipped with no hardware-level signal (ROADMAP
+item 5): MFU claims rested on hand-coded FLOP formulas, retrace counters
+said "how many" but never "why", and a faulted request's lifecycle could
+only be reconstructed from print statements. This package is the layer
+that lets every perf claim be *derived* instead of asserted:
+
+- **Compile & retrace tracing** (`compile_trace.py`): every executable
+  compile records wall time and a structure-key signature; a retrace
+  additionally records a human-readable diff against the nearest cached
+  entry — which aval shape/dtype or static arg changed. Wired into
+  `core.dispatch` (eager/lazy executables) and the serving scheduler
+  (engine prefill/decode/verify signatures).
+- **XLA cost-based accounting** (`costs.py`): `CostCard` wraps
+  `lower().compile().cost_analysis()/memory_analysis()` — compiler-
+  reported FLOPs, bytes accessed, and memory footprint per executable,
+  cached in a `CostBook` together with call counts and wall time so
+  `profiler.summary()` can print achieved FLOP/s per executable and
+  `bench.py` derives MFU from what XLA actually compiled.
+- **Per-request serving timelines + flight recorder** (`timeline.py`):
+  correlated spans (one track per request, one per engine dispatch) in
+  the chrome-trace export, plus a bounded in-memory flight recorder
+  dumped to `profiler_log/flight_*.jsonl` on fault/stall.
+- **Bench baseline store** (`baseline.py`, stdlib-only): per-scenario
+  per-platform last-good results under `profiler_log/baselines/`,
+  compared by `tools/bench_diff.py` (>5 % regression fails).
+
+Everything is OFF by default and costs nothing while off: instrumented
+sites check one module-level bool (`enabled()`); no span is allocated, no
+signature is built, and `cost_analysis()` is never invoked when disabled
+(asserted by tests/test_observability.py).
+"""
+from __future__ import annotations
+
+from . import compile_trace, costs, timeline
+from .baseline import BaselineStore, compare_reports
+from .compile_trace import CompileRecord, compiles, retrace_causes
+from .costs import CostBook, CostCard, cost_book
+from .timeline import (dispatch_span, dump_flight, events, flight_events,
+                       request_event)
+
+__all__ = [
+    "enable", "disable", "enabled", "reset",
+    "CompileRecord", "compiles", "retrace_causes",
+    "CostBook", "CostCard", "cost_book",
+    "request_event", "dispatch_span", "events", "flight_events",
+    "dump_flight",
+    "BaselineStore", "compare_reports",
+]
+
+_enabled = False
+
+
+def enabled() -> bool:
+    """One-bool gate every instrumented site checks first. Keep this a
+    plain module attribute read — it IS the disabled-path overhead."""
+    return _enabled
+
+
+def enable(flight_capacity: int = 4096):
+    """Turn the observability layer on (idempotent). `flight_capacity`
+    bounds the in-memory flight recorder (events, not bytes)."""
+    global _enabled
+    timeline.configure(flight_capacity)
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def reset():
+    """Drop recorded state (tests / measurement-window boundaries); does
+    not change enabled/disabled."""
+    compile_trace.reset()
+    costs.reset()
+    timeline.reset()

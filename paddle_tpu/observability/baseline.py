@@ -1,0 +1,145 @@
+"""Per-scenario bench baseline store + regression comparison.
+
+STDLIB-ONLY by contract: `bench.py`'s parent process must stay jax-free
+(the TPU probe owns the chip), and `tools/bench_diff.py` must run
+anywhere. Do not import jax, numpy, or the rest of the package here.
+
+Layout: one JSON file per scenario under ``profiler_log/baselines/``:
+``{"scenario", "platform", "value", "unit", "extras", "saved_wall_time"}``
+— the last-good result for that scenario. Platform rules
+(ISSUE 7 satellite — BENCH_r04/r05 silently wrote CPU-fallback numbers
+into the TPU namespace):
+
+- every stored result is tagged with its ``platform``;
+- a CPU result NEVER overwrites a TPU baseline (`update` refuses and
+  says why); a TPU result may replace a CPU one (upgrade).
+
+`compare_reports` is the gate `tools/bench_diff.py` wraps: a run whose
+gated metric regresses more than `gate_pct` (default 5 %) against the
+stored baseline fails.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["BaselineStore", "compare_reports", "GATED_METRICS",
+           "DEFAULT_GATE_PCT"]
+
+DEFAULT_GATE_PCT = 5.0
+
+# Gated metrics per scenario: (dotted path into the report, direction).
+# Only metrics listed here gate; everything else in `extras` is evidence.
+GATED_METRICS: Dict[str, List[Tuple[str, str]]] = {
+    "train_mfu": [("value", "higher")],
+    "serving_throughput": [("value", "higher"),
+                           ("extras.ttft_p99_ms", "lower")],
+    "serving_spec": [("value", "higher")],
+}
+_DEFAULT_GATES = [("value", "higher")]
+
+
+def _get_path(report: dict, dotted: str):
+    cur = report
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) and not isinstance(
+        cur, bool) else None
+
+
+class BaselineStore:
+    """Last-good bench results, one JSON per scenario."""
+
+    def __init__(self, root: Optional[str] = None):
+        if root is None:
+            root = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+                "profiler_log", "baselines")
+        self.root = root
+
+    def path(self, scenario: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in scenario)
+        return os.path.join(self.root, f"{safe}.json")
+
+    def load(self, scenario: str) -> Optional[dict]:
+        try:
+            with open(self.path(scenario)) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    def update(self, report: dict) -> Tuple[bool, str]:
+        """Store `report` as the scenario's last-good baseline, enforcing
+        the platform rules. Returns (saved, reason)."""
+        scenario = report.get("scenario")
+        platform = report.get("platform")
+        if not scenario:
+            return False, "report has no scenario tag"
+        if not platform:
+            return False, "report has no platform tag"
+        if report.get("extras", {}).get("stale"):
+            return False, "stale carry-forward result, not a fresh run"
+        prev = self.load(scenario)
+        if prev is not None:
+            prev_platform = prev.get("platform")
+            if prev_platform == "tpu" and platform != "tpu":
+                return False, (f"refusing to overwrite TPU baseline with "
+                               f"{platform} fallback result")
+        os.makedirs(self.root, exist_ok=True)
+        stored = dict(report)
+        stored["saved_wall_time"] = time.time()
+        tmp = self.path(scenario) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(stored, f, indent=1)
+        os.replace(tmp, self.path(scenario))
+        return True, ("baseline saved" if prev is None
+                      else f"baseline updated (was {prev.get('platform')})")
+
+
+def compare_reports(run: dict, baseline: dict,
+                    gate_pct: float = DEFAULT_GATE_PCT,
+                    gates: Optional[List[Tuple[str, str]]] = None) -> dict:
+    """Gate `run` against `baseline`. Returns
+    ``{"ok", "skipped", "reason", "checks": [...]}`` where each check is
+    ``{"metric", "direction", "baseline", "run", "delta_pct",
+    "regression"}``. `ok` is False iff any gated metric regressed more
+    than `gate_pct` percent. Platform-mismatched pairs are SKIPPED, not
+    passed silently: comparing CPU toy shapes against TPU numbers is
+    meaningless in both directions."""
+    scenario = run.get("scenario") or baseline.get("scenario")
+    if gates is None:
+        gates = GATED_METRICS.get(scenario, _DEFAULT_GATES)
+    if run.get("platform") != baseline.get("platform"):
+        return {"ok": True, "skipped": True,
+                "reason": f"platform mismatch: run={run.get('platform')} "
+                          f"baseline={baseline.get('platform')}",
+                "checks": []}
+    checks = []
+    ok = True
+    for dotted, direction in gates:
+        b = _get_path(baseline, dotted)
+        r = _get_path(run, dotted)
+        if b is None or r is None or b == 0:
+            checks.append({"metric": dotted, "direction": direction,
+                           "baseline": b, "run": r, "delta_pct": None,
+                           "regression": False, "note": "not comparable"})
+            continue
+        # delta_pct > 0 always means "better"
+        delta = (r - b) / abs(b) * 100.0
+        if direction == "lower":
+            delta = -delta
+        regression = delta < -gate_pct
+        ok = ok and not regression
+        checks.append({"metric": dotted, "direction": direction,
+                       "baseline": b, "run": r,
+                       "delta_pct": round(delta, 2),
+                       "regression": regression})
+    return {"ok": ok, "skipped": False,
+            "reason": "pass" if ok else f"regression > {gate_pct}%",
+            "checks": checks}

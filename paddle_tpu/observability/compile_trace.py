@@ -1,0 +1,246 @@
+"""Compile & retrace tracing: who compiled, how long, and — on a
+retrace — exactly WHAT changed versus the nearest cached signature.
+
+Two producers feed this module:
+
+- `core.dispatch` (eager / lazy-region executables): every cache miss
+  calls :func:`on_compile` with its structure key
+  ``(name, attrs, avals, ...)``; the first invocation of the new
+  executable reports its wall time back through the returned record.
+- the serving scheduler: every engine dispatch records its argument
+  signature via :func:`note_signature`; when the engine's trace-time
+  ``serving.*_retraces`` counter moved during the dispatch, the
+  scheduler calls :func:`note_retrace` and the diff against the
+  previous signature becomes the retrace CAUSE ("arg1 shape
+  (1,16)->(1,32)") — the "why" behind the counter.
+
+Both surfaces land in :func:`compiles` / :func:`retrace_causes` (bounded
+deques) and in monitor counters ``observability.compiles`` /
+``observability.retraces``; `profiler.summary()` renders them as the
+"Compiles:" section.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["CompileRecord", "on_compile", "note_signature", "note_retrace",
+           "diff_signatures", "compiles", "retrace_causes", "reset"]
+
+_MAX_RECORDS = 1024     # bounded: a long-running server must not grow
+_MAX_KEYS_PER_NAME = 8  # cached signatures kept per executable name
+
+
+class CompileRecord:
+    """One executable compile (or retrace)."""
+
+    __slots__ = ("kind", "name", "key", "wall_s", "cause", "is_retrace")
+
+    def __init__(self, kind: str, name: str, key, cause: Optional[str],
+                 is_retrace: bool):
+        self.kind = kind          # "fwd" | "fwd_vjp" | "fwd_grad" | phase
+        self.name = name
+        self.key = key
+        self.wall_s: Optional[float] = None  # set after the first call
+        self.cause = cause        # None on a first compile
+        self.is_retrace = is_retrace
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "name": self.name,
+                "wall_ms": None if self.wall_s is None
+                else round(self.wall_s * 1e3, 3),
+                "retrace": self.is_retrace, "cause": self.cause}
+
+    def __repr__(self):
+        tag = "retrace" if self.is_retrace else "compile"
+        wall = "?" if self.wall_s is None else f"{self.wall_s * 1e3:.1f}ms"
+        return (f"CompileRecord({tag} {self.kind}:{self.name} {wall}"
+                + (f" cause={self.cause}" if self.cause else "") + ")")
+
+
+_lock = threading.Lock()
+_records: deque = deque(maxlen=_MAX_RECORDS)
+_causes: deque = deque(maxlen=_MAX_RECORDS)
+# per (kind, name): recent structure keys, newest last
+_seen: Dict[Tuple[str, str], deque] = {}
+# per name: last argument signature (serving dispatch attribution)
+_last_sig: Dict[str, tuple] = {}
+
+
+def reset():
+    with _lock:
+        _records.clear()
+        _causes.clear()
+        _seen.clear()
+        _last_sig.clear()
+
+
+def compiles() -> List[CompileRecord]:
+    with _lock:
+        return list(_records)
+
+
+def retrace_causes() -> List[dict]:
+    """Recorded retraces with their attributed cause, oldest first:
+    ``{"name", "kind", "cause"}`` dicts."""
+    with _lock:
+        return list(_causes)
+
+
+# ---------------------------------------------------------------------------
+# signature diffing
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v) -> str:
+    s = str(v)
+    return s if len(s) <= 48 else s[:45] + "..."
+
+
+def _diff_avals(old, new, out: List[str]):
+    if len(old) != len(new):
+        out.append(f"arity {len(old)}->{len(new)}")
+    for i in range(min(len(old), len(new), 16)):
+        o, w = old[i], new[i]
+        if o == w:
+            continue
+        if o is None or w is None:
+            out.append(f"arg{i} {_fmt(o)}->{_fmt(w)}")
+            continue
+        oshape, odt = o[0], o[1]
+        wshape, wdt = w[0], w[1]
+        if oshape != wshape:
+            out.append(f"arg{i} shape {oshape}->{wshape}")
+        if str(odt) != str(wdt):
+            out.append(f"arg{i} dtype {odt}->{wdt}")
+
+
+def _diff_attrs(old, new, out: List[str]):
+    od, nd = dict(old), dict(new)
+    for k in sorted(set(od) | set(nd)):
+        if k not in od:
+            out.append(f"static_arg {k} added={_fmt(nd[k])}")
+        elif k not in nd:
+            out.append(f"static_arg {k} removed")
+        elif od[k] != nd[k]:
+            out.append(f"static_arg {k} {_fmt(od[k])}->{_fmt(nd[k])}")
+
+
+def diff_signatures(old_key, new_key) -> List[str]:
+    """Human-readable field-level diff of two dispatch structure keys
+    ``(name, attrs, avals, *rest)`` or two plain aval signatures
+    (tuples of (shape, dtype))."""
+    out: List[str] = []
+    if not (isinstance(old_key, tuple) and isinstance(new_key, tuple)):
+        if old_key != new_key:
+            out.append(f"signature {_fmt(old_key)}->{_fmt(new_key)}")
+        return out
+    # dispatch keys lead with the op name and pack attrs at [1], avals at
+    # [2]; plain serving signatures are bare aval tuples
+    if (len(old_key) >= 3 and isinstance(old_key[0], str)
+            and len(new_key) >= 3 and isinstance(new_key[0], str)):
+        _diff_attrs(old_key[1], new_key[1], out)
+        _diff_avals(old_key[2], new_key[2], out)
+        for i in range(3, min(len(old_key), len(new_key))):
+            if old_key[i] != new_key[i]:
+                out.append(f"key[{i}] {_fmt(old_key[i])}->{_fmt(new_key[i])}")
+    else:
+        _diff_avals(old_key, new_key, out)
+    if not out and old_key != new_key:
+        out.append("key changed (unattributed)")
+    return out
+
+
+def _nearest_cause(kind: str, name: str, key) -> Optional[str]:
+    """Diff `key` against the nearest (fewest-differences) cached key for
+    the same executable name."""
+    prior = _seen.get((kind, name))
+    if not prior:
+        return None
+    best: Optional[List[str]] = None
+    for old in prior:
+        d = diff_signatures(old, key)
+        if best is None or len(d) < len(best):
+            best = d
+        if best is not None and len(best) == 1:
+            break
+    return "; ".join(best) if best else None
+
+
+# ---------------------------------------------------------------------------
+# producers
+# ---------------------------------------------------------------------------
+
+
+def on_compile(kind: str, name: str, key) -> CompileRecord:
+    """Record one executable-cache miss (dispatch layer). Returns the
+    record; the caller stamps `wall_s` after timing the first call."""
+    from ..framework import monitor
+
+    with _lock:
+        cause = _nearest_cause(kind, name, key)
+        is_retrace = (kind, name) in _seen
+        rec = CompileRecord(kind, name, key, cause, is_retrace)
+        _records.append(rec)
+        _seen.setdefault((kind, name),
+                         deque(maxlen=_MAX_KEYS_PER_NAME)).append(key)
+        if is_retrace:
+            _causes.append({"name": name, "kind": kind,
+                            "cause": cause or "first signature change"})
+    monitor.inc("observability.compiles")
+    if is_retrace:
+        monitor.inc("observability.retraces")
+    return rec
+
+
+def note_signature(name: str, sig: tuple):
+    """Remember the latest argument signature for `name` (serving engine
+    dispatch); the baseline a later retrace is diffed against."""
+    with _lock:
+        _last_sig[name] = sig
+
+
+def note_retrace(name: str, sig: tuple) -> Optional[str]:
+    """The dispatch under `name` retraced with signature `sig`: attribute
+    it against the previous signature and record. Returns the cause, or
+    None when this was the FIRST trace of `name` — a compile, not a
+    retrace; callers must not count a cause for it."""
+    from ..framework import monitor
+
+    with _lock:
+        prev = _last_sig.get(name)
+        if prev is None:
+            cause = None
+        else:
+            d = diff_signatures(prev, sig)
+            cause = "; ".join(d) if d else "identical signature (jit-internal)"
+        _last_sig[name] = sig
+        rec = CompileRecord(name.split(".")[-1], name, sig, cause,
+                            prev is not None)
+        _records.append(rec)
+        if prev is not None:
+            _causes.append({"name": name, "kind": "serving",
+                            "cause": cause})
+    monitor.inc("observability.compiles")
+    if prev is not None:
+        monitor.inc("observability.retraces")
+    return cause
+
+
+def summary_lines() -> List[str]:
+    """The profiler's "Compiles:" section body."""
+    with _lock:
+        records = list(_records)
+        causes = list(_causes)
+    if not records:
+        return []
+    total = len(records)
+    retraces = sum(r.is_retrace for r in records)
+    timed = [r.wall_s for r in records if r.wall_s is not None]
+    lines = ["",
+             f"Compiles: {total} ({retraces} retraces, "
+             f"{sum(timed) * 1e3:.1f} ms in timed first calls)"]
+    for c in causes[-8:]:
+        lines.append(f"  retrace {c['name']}: {c['cause']}")
+    return lines

@@ -316,9 +316,20 @@ class Profiler:
 
     # -- export / summary ----------------------------------------------------
     def _export_chrome(self, path: str):
-        events = []
+        # correlated serving timelines (observability layer): one track
+        # per request, one for engine dispatches. Exported only while
+        # observability is ENABLED — a ring left over from an earlier,
+        # since-disabled session must not pollute an unrelated export.
+        # ONE clock base across host spans and timeline tracks keeps
+        # every ts positive and the tracks aligned.
+        from .. import observability as _obs
+
         rec = self.recorder
-        base = min((e.start for e in rec.events), default=0.0) if rec else 0.0
+        tl_events = _obs.timeline.events() if _obs.enabled() else []
+        candidates = [e.start for e in rec.events] if rec else []
+        candidates += [e.t0 for e in tl_events]
+        base = min(candidates, default=0.0)
+        events = []
         if rec:
             for e in rec.events:
                 events.append({
@@ -327,6 +338,8 @@ class Profiler:
                     "dur": (e.end - e.start) * 1e6,
                     "pid": os.getpid(), "tid": e.tid,
                 })
+        if tl_events:
+            events.extend(_obs.timeline.chrome_events(base))
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "deviceTraceDir": self._device_trace_dir}, f)
@@ -369,49 +382,62 @@ class Profiler:
         lines.extend(self._lazy_summary_lines())
         lines.extend(self._serving_summary_lines())
         lines.extend(self._resilience_summary_lines())
+        lines.extend(self._observability_summary_lines())
         return "\n".join(lines)
 
+    # Every section builder scrapes through ONE snapshot of the monitor
+    # registry (`monitor.snapshot(prefix)`) instead of N point reads +
+    # hand-rolled get_all() filters per section.
     @staticmethod
-    def _lazy_summary_lines():
+    def _reason_counts(snap: dict, prefix: str) -> dict:
+        """Non-zero `<prefix><reason>` counters keyed by reason — the
+        shared sub-counter formatting every section used to re-implement."""
+        return {k[len(prefix):]: v for k, v in snap.items()
+                if k.startswith(prefix) and v}
+
+    @staticmethod
+    def _kv_join(reasons: dict) -> str:
+        return ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+
+    @classmethod
+    def _lazy_summary_lines(cls):
         """Lazy eager-region stats (core/lazy.py): how many flushes ran in
         the profiled window, why, and how large the fused regions were —
         the `lazy_region_flush[...]` host spans above are the per-flush
         timings."""
         from ..framework import monitor
 
-        flushes = monitor.get("lazy.flushes")
+        snap = monitor.snapshot("lazy.", include_histograms=False)
+        g = snap.get
+        flushes = g("lazy.flushes", 0)
         if not flushes:
             return []
-        fused = monitor.get("lazy.fused_ops")
-        reasons = {k[len("lazy.flushes."):]: v
-                   for k, v in monitor.get_all().items()
-                   if k.startswith("lazy.flushes.") and v}
+        fused = g("lazy.fused_ops", 0)
         return [
             "",
             f"Lazy eager regions: {flushes} flushes, {fused} ops fused "
             f"(avg {fused / max(flushes, 1):.1f}/region, "
-            f"max {monitor.get('lazy.max_region_ops')}), "
-            f"fused-backward {monitor.get('lazy.fused_backward')}",
-            "Flush reasons: " + ", ".join(
-                f"{k}={v}" for k, v in sorted(reasons.items())),
+            f"max {g('lazy.max_region_ops', 0)}), "
+            f"fused-backward {g('lazy.fused_backward', 0)}",
+            "Flush reasons: " + cls._kv_join(
+                cls._reason_counts(snap, "lazy.flushes.")),
         ]
 
-    @staticmethod
-    def _resilience_summary_lines():
+    @classmethod
+    def _resilience_summary_lines(cls):
         """Fault-tolerance stats (resilience/): checkpoint saves + their
         transient-I/O retries, quarantined torn directories, StepGuard
         rollbacks by trip reason, AMP skip streaks, emergency preemption
         saves, and elastic heartbeat reaps."""
         from ..framework import monitor
 
-        g = monitor.get
+        snap = monitor.snapshot(include_histograms=False)
+        g = lambda k: snap.get(k, 0)  # noqa: E731
         if not (g("resilience.saves") or g("resilience.rollbacks")
                 or g("resilience.quarantines")
                 or g("resilience.emergency_saves") or g("elastic.reaped")):
             return []
-        trips = {k[len("resilience.trips."):]: v
-                 for k, v in monitor.get_all().items()
-                 if k.startswith("resilience.trips.") and v}
+        trips = cls._reason_counts(snap, "resilience.trips.")
         lines = [
             "",
             f"Resilience: {g('resilience.saves')} checkpoint saves "
@@ -424,23 +450,21 @@ class Profiler:
             f"(lock retries {g('elastic.lock_retries')})",
         ]
         if trips:
-            lines.append("  trip reasons: " + ", ".join(
-                f"{k}={v}" for k, v in sorted(trips.items())))
+            lines.append("  trip reasons: " + cls._kv_join(trips))
         return lines
 
-    @staticmethod
-    def _serving_summary_lines():
+    @classmethod
+    def _serving_summary_lines(cls):
         """Continuous-batching serving stats (serving/metrics.py): request
         outcomes, token throughput counters, latency percentiles, and the
         retrace counters that must stay flat in steady state."""
         from ..framework import monitor
 
-        g = monitor.get
+        snap = monitor.snapshot("serving.", include_histograms=False)
+        g = lambda k: snap.get(k, 0)  # noqa: E731
         if not g("serving.requests_submitted"):
             return []
-        rejected = {k[len("serving.rejected."):]: v
-                    for k, v in monitor.get_all().items()
-                    if k.startswith("serving.rejected.") and v}
+        rejected = cls._reason_counts(snap, "serving.rejected.")
         lines = [
             "",
             f"Serving: {g('serving.requests_submitted')} submitted, "
@@ -476,17 +500,14 @@ class Profiler:
                 f"(verify retraces {g('serving.verify_retraces')}, "
                 f"sample retraces {g('serving.sample_retraces')})")
         if rejected:
-            lines.append("  reject reasons: " + ", ".join(
-                f"{k}={v}" for k, v in sorted(rejected.items())))
+            lines.append("  reject reasons: " + cls._kv_join(rejected))
         # Overload/faults block: only rendered when the fault-tolerance
         # layer actually acted (shed, isolated, restarted, or stalled)
         if (g("serving.shed_total") or g("serving.isolated_faults")
                 or g("serving.step_faults") or g("serving.engine_restarts")
                 or g("serving.stall_detections")
                 or g("serving.requests_failed")):
-            from ..serving.metrics import ServingMetrics
-
-            shed_by = ServingMetrics.shed_by_reason()
+            shed_by = cls._reason_counts(snap, "serving.shed.")
             lines.append(
                 f"  overload/faults: {g('serving.shed_total')} shed, "
                 f"{g('serving.isolated_faults')} isolated faults, "
@@ -495,6 +516,15 @@ class Profiler:
                 f"{g('serving.engine_restarts')} engine restarts, "
                 f"{g('serving.stall_detections')} stall detections")
             if shed_by:
-                lines.append("  shed reasons: " + ", ".join(
-                    f"{k}={v}" for k, v in sorted(shed_by.items())))
+                lines.append("  shed reasons: " + cls._kv_join(shed_by))
+        return lines
+
+    @staticmethod
+    def _observability_summary_lines():
+        """Compile/retrace records and the per-executable cost table
+        (observability layer) — empty unless something was recorded."""
+        from .. import observability as _obs
+
+        lines = list(_obs.compile_trace.summary_lines())
+        lines.extend(_obs.costs.summary_lines())
         return lines

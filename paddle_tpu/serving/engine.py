@@ -209,6 +209,18 @@ class MLPLMEngine:
         engine class (`engine_factory=broken_engine.respawn`)."""
         return MLPLMEngine(**self._init_kwargs)
 
+    def cost_card_args(self, phase: str):
+        """Observability hook (`observability.costs.ensure_engine_card`):
+        the jitted executable behind `phase` plus the leading arguments
+        the scheduler never sees (params, cache). The scheduler appends
+        its own call arrays and lowers the pair for
+        `cost_analysis()`/`memory_analysis()` — compiler-reported FLOPs
+        per dispatch, cached alongside the executable. Optional on
+        EngineCore: engines without it simply have no CostCard."""
+        fn = {"prefill": self._prefill, "decode": self._decode,
+              "verify": self._verify}[phase]
+        return fn, (self.params, self.cache)
+
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
                 lens: Optional[np.ndarray] = None) -> np.ndarray:
         import jax.numpy as jnp

@@ -61,6 +61,17 @@ class RequestHandle:
     def num_preemptions(self) -> int:
         return self._req.num_preemptions
 
+    def timeline(self) -> list:
+        """This request's recorded observability events (oldest first),
+        as dicts — empty unless `observability.enable()` was on while it
+        was served. The debugging surface behind the chrome-trace
+        request tracks: queued -> admitted -> prefill -> decode/verify
+        rounds -> (preempted ->) terminal."""
+        from .. import observability as _obs
+
+        return [e.as_dict() for e in _obs.timeline.events()
+                if e.req_id == self._req.req_id]
+
     def ttft_ms(self) -> Optional[float]:
         t = self._req.ttft()
         return None if t is None else t * 1e3
@@ -153,6 +164,12 @@ class ServingFrontend:
             return
         if self.stall_after and not sch.idle \
                 and sch.zero_progress_steps >= self.stall_after:
+            from .. import observability as _obs
+
+            if _obs.enabled():
+                # post-mortem: the rounds that led to the wedge, on disk
+                # before the typed raise unwinds the driver loop
+                _obs.timeline.dump_flight("engine_stalled")
             mgr = sch.engine.manager
             raise EngineStalled(
                 sch.zero_progress_steps,

@@ -89,8 +89,7 @@ class ServingMetrics:
         `serving.shed.<reason>` counter namespace (profiler summary and
         bench extras both render this)."""
         return {k[len("serving.shed."):]: v
-                for k, v in monitor.get_all().items()
-                if k.startswith("serving.shed.") and v}
+                for k, v in monitor.snapshot("serving.shed.").items() if v}
 
     def on_preempt(self):
         monitor.inc("serving.preemptions")
@@ -126,6 +125,9 @@ class ServingMetrics:
         t = req.ttft()
         if t is not None:
             self.ttft_s.append(t)
+            # fixed-bucket histogram: the Prometheus-scrapable latency
+            # distribution (percentile gauges below stay for summary())
+            monitor.observe("serving.ttft_seconds", t)
 
     def on_finish(self, req):
         from .scheduler import RequestStatus
@@ -140,6 +142,7 @@ class ServingMetrics:
         t = req.tpot()
         if t is not None:
             self.tpot_s.append(t)
+            monitor.observe("serving.tpot_seconds", t)
         self._finishes += 1
         # percentile passes are O(window): publish on the first finish
         # (so gauges exist) then every few — summary() always recomputes
@@ -182,24 +185,24 @@ class ServingMetrics:
         if decoded:
             self._steps += 1
             self._occ_sum += occupancy
-            monitor.set_value("serving.batch_occupancy_pct",
+            monitor.set_gauge("serving.batch_occupancy_pct",
                               round(occupancy * 100.0, 1))
-            monitor.set_value("serving.batch_occupancy_avg_pct",
+            monitor.set_gauge("serving.batch_occupancy_avg_pct",
                               round(self._occ_sum / self._steps * 100.0, 1))
-        monitor.set_value("serving.kv_utilization_pct",
+        monitor.set_gauge("serving.kv_utilization_pct",
                           round(kv_utilization * 100.0, 1))
         monitor.set_max("serving.kv_utilization_peak_pct",
                         round(kv_utilization * 100.0, 1))
-        monitor.set_value("serving.queue_depth", queue_depth)
+        monitor.set_gauge("serving.queue_depth", queue_depth)
         monitor.set_max("serving.queue_depth_peak", queue_depth)
 
     def gauge_queue(self, depth: int, queued_cost: Optional[int] = None):
-        monitor.set_value("serving.queue_depth", depth)
+        monitor.set_gauge("serving.queue_depth", depth)
         monitor.set_max("serving.queue_depth_peak", depth)
         if queued_cost is not None:
             # max_new_tokens-weighted backlog: what the cost watermark
             # and the deadline-shed estimate actually latch on
-            monitor.set_value("serving.queued_cost", queued_cost)
+            monitor.set_gauge("serving.queued_cost", queued_cost)
             monitor.set_max("serving.queued_cost_peak", queued_cost)
 
     def _publish_latency(self):
@@ -209,12 +212,14 @@ class ServingMetrics:
                            float(np.mean(self.tpot_s)) if self.tpot_s
                            else None)):
             if val is not None:
-                monitor.set_value(name, round(val * 1e3, 3))
+                monitor.set_gauge(name, round(val * 1e3, 3))
 
     # ---- reporting ----
     def summary(self) -> Dict[str, object]:
-        out = {k: v for k, v in monitor.get_all().items()
-               if k.startswith("serving.")}
+        # the scalar slice of the registry; the histogram expansion
+        # (ttft_seconds_bucket_*) stays out of summary() — callers key on
+        # exact metric names
+        out = monitor.snapshot("serving.", include_histograms=False)
         out["serving.ttft_p50_ms"] = _r(_pct(self.ttft_s, 50))
         out["serving.ttft_p99_ms"] = _r(_pct(self.ttft_s, 99))
         out["serving.tpot_mean_ms"] = _r(
@@ -223,10 +228,9 @@ class ServingMetrics:
 
     @staticmethod
     def reset_monitor():
-        """Zero every serving.* monitor counter (tests, engine swap)."""
-        for k in list(monitor.get_all()):
-            if k.startswith("serving."):
-                monitor.reset(k)
+        """Zero every serving.* monitor counter/histogram (tests,
+        engine swap)."""
+        monitor.reset_prefix("serving.")
 
 
 def _r(seconds: Optional[float]) -> Optional[float]:

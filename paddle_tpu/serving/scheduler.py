@@ -60,6 +60,8 @@ from typing import Callable, Deque, List, Optional
 
 import numpy as np
 
+from .. import observability as _obs
+from ..framework import monitor as _monitor
 from ..framework.retry import Budget, retry_call
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
 from ..ops.sampling import sample_tokens
@@ -265,6 +267,10 @@ class Scheduler:
         now = self._clock() if now is None else now
         req.t_submit = now
         self.metrics.on_submit()
+        if _obs.enabled():
+            self._obs_req(req, "queued", t0=now,
+                          prompt_tokens=int(len(req.prompt)),
+                          max_new_tokens=req.sampling.max_new_tokens)
         if self._broken is not None:
             return self._reject(req, self._broken)
         mgr = self.engine.manager
@@ -299,6 +305,9 @@ class Scheduler:
         req.finish_reason = reason
         req.t_finish = self._clock()
         self.metrics.on_reject(reason)
+        if _obs.enabled():
+            self._obs_req(req, "terminal:rejected", t0=req.t_finish,
+                          reason=reason)
         return req
 
     def _shed(self, req: Request, reason: str) -> Request:
@@ -306,6 +315,9 @@ class Scheduler:
         req.finish_reason = reason
         req.t_finish = self._clock()
         self.metrics.on_shed(reason)
+        if _obs.enabled():
+            self._obs_req(req, "terminal:shed", t0=req.t_finish,
+                          reason=reason)
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -410,6 +422,11 @@ class Scheduler:
         injection asks the caller to poison one lane (NaN path).
         Returns (result, flagged)."""
         flagged = _faults.check_flag(f"serve.{phase}")
+        obs_on = _obs.enabled()
+        if obs_on:
+            # trace-time counter snapshot: a bump during the call below
+            # means THIS dispatch retraced — its signature diff is the why
+            retraces_before = _monitor.get(f"serving.{phase}_retraces")
         t0 = self._clock()
         try:
             out = fn(*args)
@@ -426,7 +443,40 @@ class Scheduler:
             # price once it knows how many tokens the round committed
             # (a verify dispatch commits up to K+1 per lane).
             self._last_decode_dt = dt
+        if obs_on:
+            self._obs_dispatch(phase, args, t0, dt, retraces_before)
         return out, flagged
+
+    def _obs_dispatch(self, phase: str, args, t0: float, dt: float,
+                      retraces_before: int):
+        """Observability bookkeeping for one successful dispatch: retrace
+        cause attribution (signature diff vs the previous dispatch of the
+        same phase), the engine-track timeline span, per-executable call
+        accounting, and — once per phase — the XLA CostCard. Only ever
+        called with observability enabled."""
+        name = f"serve.{phase}"
+        sig = tuple((np.shape(a), str(np.asarray(a).dtype)) for a in args)
+        if _monitor.get(f"serving.{phase}_retraces") > retraces_before:
+            cause = _obs.compile_trace.note_retrace(name, sig)
+            if cause is not None:   # None = first trace: not a retrace
+                _monitor.inc(f"serving.{phase}_retrace_causes."
+                             + ("shape" if "shape" in cause else
+                                "dtype" if "dtype" in cause else "other"))
+        else:
+            _obs.compile_trace.note_signature(name, sig)
+        _obs.timeline.dispatch_span(phase, t0, t0 + dt)
+        _obs.costs.record_call(name, dt)
+        # the card lowers the engine fn once (one extra trace, charged to
+        # the counters AFTER the snapshot above — never misattributed)
+        _obs.costs.ensure_engine_card(name, self.engine, phase, args)
+
+    def _obs_req(self, req: Request, name: str, t0: Optional[float] = None,
+                 t1: Optional[float] = None, **meta):
+        """Request-track timeline event; call sites guard on
+        `_obs.enabled()` so the disabled path allocates nothing."""
+        _obs.timeline.request_event(
+            req.req_id, name, self._clock() if t0 is None else t0, t1,
+            **meta)
 
     def _record_tpot(self, n_lanes: int, produced: int):
         """Price the last decode/verify dispatch per lane-token: a round
@@ -498,6 +548,11 @@ class Scheduler:
             return
         self._step_faults += 1
         self.metrics.on_step_fault(phase)
+        if _obs.enabled():
+            _obs.timeline.dispatch_span(f"step_fault:{phase}",
+                                        self._clock(), None,
+                                        error=type(exc).__name__)
+            _obs.timeline.dump_flight(f"step_fault_{phase}")
         limit = self._wd.step_retries if self._wd is not None else 3
         if self._step_faults > limit:
             self._step_faults = 0
@@ -521,6 +576,12 @@ class Scheduler:
         # raising would burn TWO budget units (escalation restart, then
         # the stale pending stall restarting the fresh engine)
         self._pending_stall = None
+        if _obs.enabled():
+            # post-mortem evidence FIRST: the ring holds the rounds that
+            # led here, and the rebuild below may fail everything
+            _obs.timeline.dump_flight(f"engine_restart_{reason}")
+            _obs.timeline.dispatch_span(f"engine_restart:{reason}",
+                                        self._clock(), None)
         if self.engine_factory is None or not self._restart_budget.spend():
             self._fail_all(f"engine_unrecoverable:{reason}")
             return False
@@ -539,6 +600,9 @@ class Scheduler:
             req.num_preemptions += 1
             self._queue_push(req, front=True)
             self.metrics.on_preempt()
+            if _obs.enabled():
+                self._obs_req(req, "preempted", reason=f"restart:{reason}",
+                              tokens_kept=len(req.generated))
         try:
             engine = retry_call(
                 self.engine_factory,
@@ -635,6 +699,13 @@ class Scheduler:
                 break
             self._queue_pop()
             slot = self.slots.index(None)
+            obs_on = _obs.enabled()
+            if obs_on:
+                t_admit = self._clock()
+                self._obs_req(req, "admitted", t0=t_admit, slot=slot,
+                              queue_wait_ms=round(
+                                  (t_admit - req.t_submit) * 1e3, 3)
+                              if req.t_submit is not None else None)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :len(ctx)] = ctx
             tables = mgr.block_table_array([req.seq_id])
@@ -659,6 +730,10 @@ class Scheduler:
                 continue
             mgr.trim(req.seq_id, len(ctx))
             self.metrics.on_prefill(len(ctx))
+            if obs_on:
+                self._obs_req(req, "prefill", t0=t_admit,
+                              t1=self._clock(), tokens=int(len(ctx)),
+                              bucket=bucket)
             was_preempted = req.status is RequestStatus.PREEMPTED
             req.status = RequestStatus.RUNNING
             req._admit_seq = next(self._admit_counter)
@@ -736,6 +811,9 @@ class Scheduler:
         req.num_preemptions += 1
         self._queue_push(req, front=True)
         self.metrics.on_preempt()
+        if _obs.enabled():
+            self._obs_req(req, "preempted", reason="kv_pressure",
+                          tokens_kept=len(req.generated))
         return True
 
     def _decode(self, now: float) -> int:
@@ -825,6 +903,7 @@ class Scheduler:
             return 0
         self._step_faults = 0   # a full dispatch+sample round succeeded
         produced = 0
+        obs_on = _obs.enabled()
         for i, req in active:
             if self.slots[i] is not req:   # cancelled by a stream_cb
                 continue                   # earlier in this very loop
@@ -837,6 +916,9 @@ class Scheduler:
                 self.metrics.on_first_token(req)
             if req.stream_cb is not None:
                 req.stream_cb(req, tok)
+            if obs_on:
+                self._obs_req(req, "decode", t0=t_tok, tokens=1,
+                              total=len(req.generated))
             self._maybe_finish_on_token(req, tok, i)
         self._record_tpot(len(active), produced)
         self.metrics.on_decode(produced)
@@ -987,6 +1069,7 @@ class Scheduler:
             return 0
         self._step_faults = 0   # a full verify+sample round succeeded
         produced = proposed = accepted = 0
+        obs_on = _obs.enabled()
         for i, req, drafts, pre_len in lanes:
             if self.slots[i] is not req:   # cancelled by a stream_cb
                 continue                   # earlier in this very loop
@@ -995,12 +1078,14 @@ class Scheduler:
                 a += 1
             proposed += len(drafts)
             accepted += a
+            committed = 0
             # emit the accepted drafts (== the sampled tokens) plus the
             # bonus/correction token from the first unmatched position
             for tok in (int(picked[i, j]) for j in range(a + 1)):
                 req.generated.append(tok)
                 req._last = tok
                 produced += 1
+                committed += 1
                 if req.t_first_token is None:
                     req.t_first_token = t_tok
                     self.metrics.on_first_token(req)
@@ -1009,6 +1094,10 @@ class Scheduler:
                 self._maybe_finish_on_token(req, tok, i)
                 if req.status.terminal:
                     break
+            if obs_on:
+                self._obs_req(req, "verify_round", t0=t_tok,
+                              tokens=committed, drafts=len(drafts),
+                              accepted=a)
             if not req.status.terminal:
                 # roll back rejected speculation: keep pending + accepted
                 mgr.trim(req.seq_id, pre_len + 1 + a)
@@ -1043,6 +1132,12 @@ class Scheduler:
         req.t_finish = self._clock()
         self._finish_events += 1
         self.metrics.on_finish(req)
+        if _obs.enabled():
+            self._obs_req(req, f"terminal:{status.value}",
+                          t0=req.t_finish, reason=reason,
+                          tokens=len(req.generated))
+            if status is RequestStatus.FAILED:
+                _obs.timeline.dump_flight(f"request_failed_{reason}")
 
     def _release_spec(self, req: Request):
         """Drop any speculative-proposer state for a request leaving the

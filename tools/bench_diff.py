@@ -1,0 +1,137 @@
+"""bench_diff — gate a bench run against its stored per-scenario baseline.
+
+The machine check behind every future perf claim (ROADMAP item 5): a run
+whose gated metric regresses more than ``--gate-pct`` (default 5 %)
+against the last-good baseline under ``profiler_log/baselines/`` exits
+non-zero. Platform-mismatched pairs (CPU fallback run vs TPU baseline)
+are SKIPPED with an explicit reason — never silently compared, never
+silently passed as "no regression" unless you accept the skip; pass
+``--strict-platform`` to make a skip itself fail (CI on a TPU box).
+
+Usage:
+    python bench.py serving_throughput > run.json   # (stdout's one line)
+    python tools/bench_diff.py run.json
+    python tools/bench_diff.py run.json --gate-pct 5 --strict-platform
+    python tools/bench_diff.py - < run.json         # read stdin
+
+Exit codes: 0 pass (or accepted skip), 1 regression, 2 usage/missing
+baseline, 3 platform-mismatch skip under --strict-platform.
+
+STDLIB-ONLY (loads `paddle_tpu/observability/baseline.py` standalone):
+runs on any box, no jax import, safe next to a busy TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_baseline_mod():
+    path = os.path.join(_REPO, "paddle_tpu", "observability", "baseline.py")
+    spec = importlib.util.spec_from_file_location("_pt_baseline", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_run(arg: str) -> dict:
+    text = sys.stdin.read() if arg == "-" else open(arg).read()
+    # bench stdout is ONE json line, but tolerate surrounding noise lines
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in obj or "scenario" in obj:
+                return obj
+    raise ValueError("no bench JSON line found in input")
+
+
+# metric-name fallback for artifacts that predate the scenario tag
+_METRIC_TO_SCENARIO = {
+    "llama_train_mfu_1chip": "train_mfu",
+    "serving_throughput": "serving_throughput",
+    "serving_throughput_spec": "serving_spec",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate a bench run against its stored baseline")
+    ap.add_argument("run", help="bench output JSON file, or - for stdin")
+    ap.add_argument("--baseline-dir", default=None,
+                    help="baseline store root (default "
+                         "profiler_log/baselines/)")
+    ap.add_argument("--gate-pct", type=float, default=None,
+                    help="regression tolerance in percent (default 5)")
+    ap.add_argument("--strict-platform", action="store_true",
+                    help="a platform-mismatch skip exits 3 instead of 0")
+    ap.add_argument("--update", action="store_true",
+                    help="on pass, also store this run as the new "
+                         "last-good baseline")
+    args = ap.parse_args(argv)
+
+    bl = _load_baseline_mod()
+    gate_pct = (bl.DEFAULT_GATE_PCT if args.gate_pct is None
+                else args.gate_pct)
+    try:
+        run = _read_run(args.run)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: cannot read run: {e}", file=sys.stderr)
+        return 2
+    scenario = run.get("scenario") or _METRIC_TO_SCENARIO.get(
+        run.get("metric", ""))
+    if not scenario:
+        print("bench_diff: run has neither scenario tag nor known metric",
+              file=sys.stderr)
+        return 2
+    run.setdefault("scenario", scenario)
+    store = bl.BaselineStore(args.baseline_dir)
+    baseline = store.load(scenario)
+    if baseline is None:
+        print(f"bench_diff: no baseline for scenario {scenario!r} under "
+              f"{store.root} — run the scenario once (bench.py stores "
+              f"last-good automatically) or pass --update", file=sys.stderr)
+        if args.update:
+            saved, reason = store.update(run)
+            print(f"bench_diff: {reason}", file=sys.stderr)
+            return 0 if saved else 2
+        return 2
+
+    result = bl.compare_reports(run, baseline, gate_pct=gate_pct)
+    out = {
+        "scenario": scenario,
+        "gate_pct": gate_pct,
+        "baseline_platform": baseline.get("platform"),
+        "run_platform": run.get("platform"),
+        "baseline_saved_wall_time": baseline.get("saved_wall_time"),
+        **result,
+    }
+    print(json.dumps(out, indent=1))
+    if result.get("skipped"):
+        print(f"bench_diff: SKIPPED — {result['reason']}", file=sys.stderr)
+        return 3 if args.strict_platform else 0
+    if not result["ok"]:
+        worst = [c for c in result["checks"] if c["regression"]]
+        for c in worst:
+            print(f"bench_diff: REGRESSION {c['metric']}: "
+                  f"{c['baseline']} -> {c['run']} "
+                  f"({c['delta_pct']:+.2f}% vs gate -{gate_pct}%)",
+                  file=sys.stderr)
+        return 1
+    print("bench_diff: PASS", file=sys.stderr)
+    if args.update:
+        saved, reason = store.update(run)
+        print(f"bench_diff: {reason}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

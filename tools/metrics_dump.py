@@ -1,0 +1,54 @@
+"""Dump the framework.monitor registry — Prometheus text format or JSON.
+
+The scrape-side companion of `framework/monitor.py`'s typed registry:
+run a workload in-process (``--exec``) or import a module that populates
+counters, then print the whole registry (or a ``--prefix`` slice) the
+way a Prometheus scraper would see it.
+
+Usage:
+    python tools/metrics_dump.py [--format prom|json] [--prefix serving.]
+                                 [--exec "python -c ..."-style snippet]
+
+Examples:
+    # render whatever a short serving run left in the registry
+    python tools/metrics_dump.py --prefix serving. --exec \
+        "import tools.serving_smoke"
+    # empty registry still renders valid (empty) exposition
+    python tools/metrics_dump.py --format prom
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--prefix", default=None,
+                    help="only metrics whose name starts with this")
+    ap.add_argument("--exec", dest="snippet", default=None,
+                    help="python snippet run before dumping (to populate "
+                         "the registry in-process)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.framework import monitor
+
+    if args.snippet:
+        exec(compile(args.snippet, "<metrics_dump --exec>", "exec"), {})
+
+    if args.format == "json":
+        print(json.dumps(monitor.snapshot(args.prefix), indent=1,
+                         sort_keys=True))
+    else:
+        sys.stdout.write(monitor.render_prometheus(args.prefix))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

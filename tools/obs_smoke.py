@@ -1,0 +1,185 @@
+"""Observability smoke: the whole layer, end to end, in <15 s on CPU.
+
+Drives a short serving trace (tiny MLP engine) with observability AND the
+profiler on, covering a preemption (tight KV pool) and an injected
+`serve.decode` fault, then asserts the layer's artifacts:
+
+1. the chrome-trace export contains CORRELATED per-request tracks
+   (queued -> admitted -> prefill -> decode -> terminal) plus the engine
+   dispatch track, a preemption marker, and the injected-fault marker;
+2. the flight recorder dumped a `flight_*.jsonl` on the injected fault,
+   and the dump replays the rounds leading up to it;
+3. retrace causes were attributed (the prefill bucket family compiles
+   with named shape diffs) and per-executable CostCards exist;
+4. `tools/bench_diff.py` PASSES on a self-baseline and FAILS (exit 1) on
+   a doctored 10 % regression against the same baseline.
+
+Usage: python tools/obs_smoke.py
+Exit code 0 on success; prints one JSON line with the smoke's evidence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def serving_trace(tmp):
+    import paddle_tpu.observability as obs
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (MLPLMEngine, RequestStatus,
+                                    ServingFrontend, ServingMetrics)
+
+    ServingMetrics.reset_monitor()
+    obs.enable()
+    obs.reset()
+    obs.timeline.configure(flight_dir=tmp)
+    # tight pool: two long-running requests + a third forces preemption
+    fe = ServingFrontend(MLPLMEngine(
+        vocab_size=64, hidden=16, max_batch_size=3, num_blocks=14,
+        block_size=4, max_blocks_per_seq=8))
+    rng = np.random.default_rng(0)
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    # transient decode fault a few rounds in: unattributed -> survivors
+    # replay, flight recorder dumps
+    faults.inject("serve.decode", after_n=3, times=1)
+    handles = [fe.submit(rng.integers(1, 64, n).tolist(),
+                         max_new_tokens=g)
+               for n, g in ((6, 24), (9, 24), (5, 20), (4, 6), (7, 8))]
+    fe.run_until_idle(max_steps=3000)
+    prof.stop()
+    faults.clear()
+
+    term = [h.status for h in handles]
+    assert all(s.terminal for s in term), term
+    assert all(s in (RequestStatus.FINISHED,) for s in term), term
+    preemptions = monitor.get("serving.preemptions")
+    assert preemptions >= 1, \
+        f"smoke needs a preemption in-trace (got {preemptions})"
+    assert monitor.get("serving.step_faults") >= 1, "fault never fired?"
+
+    # ---- chrome export: correlated request tracks ----
+    trace_path = os.path.join(tmp, "obs_trace.json")
+    prof.export(trace_path)
+    data = json.load(open(trace_path))
+    serving_ev = [e for e in data["traceEvents"] if e.get("pid") == "serving"]
+    assert serving_ev, "no serving timeline in chrome export"
+    by_tid = {}
+    for e in serving_ev:
+        if e.get("ph") == "M":
+            continue
+        by_tid.setdefault(e["tid"], []).append(e["name"])
+    # tid 0 = engine dispatches; request tracks must cover the lifecycle
+    full_tracks = 0
+    for tid, names in by_tid.items():
+        if tid == 0:
+            continue
+        if ({"queued", "admitted", "prefill", "decode"} <= set(names)
+                and any(n.startswith("terminal:") for n in names)):
+            full_tracks += 1
+    assert full_tracks >= 3, \
+        f"want >=3 full queued->prefill->decode->terminal tracks: {by_tid}"
+    all_names = [n for ns in by_tid.values() for n in ns]
+    assert "preempted" in all_names, "preemption missing from timeline"
+    assert any(n.startswith("step_fault:decode") for n in by_tid.get(0, [])), \
+        "injected decode fault missing from dispatch track"
+    # correlation: every non-engine event carries its req_id
+    assert all(e.get("args", {}).get("req_id") is not None
+               for e in serving_ev
+               if e.get("ph") != "M" and e["tid"] != 0)
+
+    # ---- flight recorder dumped on the injected fault ----
+    flights = [f for f in os.listdir(tmp) if f.startswith("flight_")]
+    assert flights, "flight recorder never dumped"
+    fpath = os.path.join(tmp, sorted(flights)[0])
+    lines = [json.loads(ln) for ln in open(fpath)]
+    assert lines[0].get("flight_recorder") and lines[0]["events"] >= 1
+    assert any(ev.get("name") == "queued" for ev in lines[1:]), \
+        "flight dump lost the pre-fault lifecycle"
+
+    # ---- retrace causes + cost cards ----
+    causes = obs.retrace_causes()
+    assert any("shape" in c["cause"] for c in causes), causes
+    rows = {r["name"]: r for r in obs.cost_book().rows()}
+    assert rows.get("serve.decode", {}).get("flops_per_call"), rows
+    summary = prof.summary()
+    assert "Compiles:" in summary and "Executable" in summary
+
+    obs.disable()
+    return {
+        "requests": len(handles),
+        "preemptions": int(preemptions),
+        "step_faults": int(monitor.get("serving.step_faults")),
+        "full_request_tracks": full_tracks,
+        "retrace_causes": len(causes),
+        "flight_dumps": len(flights),
+        "decode_flops_per_call": rows["serve.decode"]["flops_per_call"],
+    }
+
+
+def bench_gate(tmp):
+    """Self-baseline passes; a doctored 10 % regression fails (exit 1)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bl", os.path.join(_REPO, "paddle_tpu", "observability",
+                            "baseline.py"))
+    bl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bl)
+    bdir = os.path.join(tmp, "baselines")
+    report = {"scenario": "serving_throughput", "platform": "cpu",
+              "metric": "serving_throughput", "value": 500.0,
+              "extras": {"ttft_p99_ms": 4.0}}
+    saved, reason = bl.BaselineStore(bdir).update(report)
+    assert saved, reason
+
+    def run_diff(rep):
+        p = os.path.join(tmp, "run.json")
+        json.dump(rep, open(p, "w"))
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "bench_diff.py"),
+             p, "--baseline-dir", bdir], capture_output=True, text=True)
+        return r.returncode
+
+    rc_self = run_diff(report)
+    assert rc_self == 0, f"self-baseline must pass, got rc={rc_self}"
+    doctored = dict(report, value=round(report["value"] * 0.90, 1))
+    rc_bad = run_diff(doctored)
+    assert rc_bad == 1, f"10% regression must exit 1, got rc={rc_bad}"
+    # CPU fallback must never displace a TPU baseline
+    tpu = dict(report, platform="tpu", value=900.0)
+    assert bl.BaselineStore(bdir).update(tpu)[0]
+    saved, reason = bl.BaselineStore(bdir).update(report)
+    assert not saved and "refusing" in reason, (saved, reason)
+    return {"self_rc": rc_self, "doctored_rc": rc_bad,
+            "cpu_overwrite_refused": True}
+
+
+def main():
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        out = serving_trace(tmp)
+        out.update(bench_gate(tmp))
+    out["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
