@@ -20,6 +20,10 @@ and collectives run at process granularity through cross-process allgather/
 broadcast primitives guarded by the comm watchdog. Sub-groups (group !=
 None) are a single-controller feature: under multi-process execution they
 raise rather than silently computing from local data.
+
+With `observability.enable()` every collective here is traced
+(kind/group/bytes/wall/algbw — `observability/comms.py`); while disabled
+the hot path pays exactly one bool check.
 """
 from __future__ import annotations
 
@@ -28,6 +32,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ... import observability as _obs
 from ...core.tensor import Tensor
 from .group import Group, _get_global_group
 
@@ -59,6 +64,53 @@ def _group(group) -> Group:
     return group if group is not None else _get_global_group()
 
 
+# collective tracing (observability/comms.py). The contract is the PR 7
+# one-bool gate: every site checks `_obs.enabled()` BEFORE computing a
+# payload size or timestamp — the disabled hot path allocates nothing.
+_TRACE_KIND = {"shift": "ppermute"}   # internal name -> traced kind
+
+
+def _per_rank_bytes(arr, nranks: int) -> int:
+    """Per-rank payload bytes of a stacked [nranks, ...] array."""
+    size = 1
+    for s in arr.shape:
+        size *= int(s)
+    return size * np.dtype(arr.dtype).itemsize // max(int(nranks), 1)
+
+
+def _traced_call(kind: str, g: Group, nbytes: int, fn):
+    """Run the device work under comm tracing: time it (blocking on the
+    result — tracing is observability-ON behavior), then record kind,
+    group, per-rank bytes, wall, and derived algbw. Callers reach this
+    only when `_obs.enabled()`."""
+    import time as _time
+
+    import jax
+
+    t0 = _time.perf_counter()
+    out = fn()
+    try:
+        jax.block_until_ready(out)
+    except Exception:
+        pass
+    _obs.comms.record(_TRACE_KIND.get(kind, kind), nranks=g.nranks,
+                      nbytes=nbytes, t0=t0,
+                      wall_s=_time.perf_counter() - t0, group=g.id)
+    return out
+
+
+def _run_compiled(kind: str, g: Group, fn, stacked):
+    """Execute one compiled collective program over the [nranks, ...]
+    stack — traced when observability is on. The shared funnel for every
+    `_compiled`-program site (`_run`, reduce_scatter, alltoall), so the
+    gate/trace contract lives in ONE place; the disabled path is the
+    plain call with one bool check and NO closure/payload allocation."""
+    if not _obs.enabled():
+        return fn(stacked)
+    return _traced_call(kind, g, _per_rank_bytes(stacked, g.nranks),
+                        lambda: fn(stacked))
+
+
 def _multiproc() -> bool:
     """True under real multi-controller execution (launch-spawned workers
     with a live JAX coordination service)."""
@@ -67,30 +119,52 @@ def _multiproc() -> bool:
     return jax.process_count() > 1
 
 
-def _mp_broadcast(arr, src: int):
+def _mp_broadcast(arr, src: int, kind: str = "broadcast"):
     """Cross-process broadcast from process `src` (one payload transfer,
-    not a P-way allgather)."""
+    not a P-way allgather). `kind` names the logical collective riding
+    this transport in the comm trace."""
+    import time as _time
+
     import jax
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     from .watchdog import watchdog_guard
 
-    with watchdog_guard("broadcast"):
+    a = np.asarray(arr)
+    trace = _obs.enabled()
+    t0 = _time.perf_counter() if trace else 0.0
+    with watchdog_guard(kind, meta={"bytes": int(a.nbytes)}):
         out = multihost_utils.broadcast_one_to_all(
-            np.asarray(arr), is_source=jax.process_index() == src)
+            a, is_source=jax.process_index() == src)
+    if trace:
+        _obs.comms.record(kind, nranks=jax.process_count(),
+                          nbytes=int(a.nbytes), t0=t0,
+                          wall_s=_time.perf_counter() - t0)
     return jnp.asarray(out)
 
 
-def _mp_allgather(arr):
-    """Cross-process allgather of a process-local value -> np [P, ...]."""
+def _mp_allgather(arr, kind: str = "all_gather"):
+    """Cross-process allgather of a process-local value -> np [P, ...].
+    `kind` names the logical collective riding this transport in the
+    comm trace (all_reduce/reduce/reduce_scatter/alltoall emulations)."""
+    import time as _time
+
+    import jax
     from jax.experimental import multihost_utils
 
     from .watchdog import watchdog_guard
 
-    with watchdog_guard("process_allgather"):
-        return np.asarray(multihost_utils.process_allgather(
-            np.asarray(arr), tiled=False))
+    a = np.asarray(arr)
+    trace = _obs.enabled()
+    t0 = _time.perf_counter() if trace else 0.0
+    with watchdog_guard(kind, meta={"bytes": int(a.nbytes)}):
+        out = np.asarray(multihost_utils.process_allgather(a, tiled=False))
+    if trace:
+        _obs.comms.record(kind, nranks=jax.process_count(),
+                          nbytes=int(a.nbytes), t0=t0,
+                          wall_s=_time.perf_counter() - t0)
+    return out
 
 
 def _group_sharding(g: Group, ndim_rest: int):
@@ -194,7 +268,7 @@ def _run(kind, t: Tensor, group, extra=None, in_place=True):
     stacked, was_stacked = _as_stack(t, g)
     key_shape = tuple(int(s) for s in stacked.shape)
     fn = _compiled(kind, g.id, key_shape, str(stacked.dtype), extra)
-    out = fn(stacked)
+    out = _run_compiled(kind, g, fn, stacked)
     if in_place:
         t._data = out if was_stacked else out[0]
         if was_stacked:
@@ -220,7 +294,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if _multiproc() and group is None:
         import jax.numpy as jnp
 
-        gathered = _mp_allgather(tensor._data)
+        gathered = _mp_allgather(tensor._data, kind="all_reduce")
         tensor._data = jnp.asarray(_REDUCERS[op](gathered, 0))
         return _FinishedTask(tensor)
     return _FinishedTask(_run("all_reduce", tensor, group, extra=op))
@@ -230,7 +304,7 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     if _multiproc() and group is None:
         import jax.numpy as jnp
 
-        gathered = _mp_allgather(tensor._data)
+        gathered = _mp_allgather(tensor._data, kind="reduce")
         # every process computes the reduction; only dst's copy is the
         # contract, extras are replicas (harmless at process granularity)
         tensor._data = jnp.asarray(_REDUCERS[op](gathered, 0))
@@ -268,7 +342,12 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
         return out
     g = _group(group)
     stacked, _ = _as_stack(tensor, g)
-    out = [Tensor(stacked[i]) for i in range(g.nranks)]
+    if _obs.enabled():
+        out = _traced_call(
+            "all_gather", g, _per_rank_bytes(stacked, g.nranks),
+            lambda: [Tensor(stacked[i]) for i in range(g.nranks)])
+    else:
+        out = [Tensor(stacked[i]) for i in range(g.nranks)]
     if tensor_list is not None:
         tensor_list.clear()
         tensor_list.extend(out)
@@ -294,7 +373,14 @@ def scatter(tensor: Tensor, tensor_list: Optional[List[Tensor]] = None,
         arr = tensor._data
         stacked = arr.reshape((g.nranks, -1) + arr.shape[1:]) \
             if arr.shape[0] % g.nranks == 0 else arr
-    stacked = jax.device_put(stacked, _group_sharding(g, stacked.ndim - 1))
+    if _obs.enabled():
+        stacked = _traced_call(
+            "scatter", g, _per_rank_bytes(stacked, g.nranks),
+            lambda: jax.device_put(stacked,
+                                   _group_sharding(g, stacked.ndim - 1)))
+    else:
+        stacked = jax.device_put(stacked,
+                                 _group_sharding(g, stacked.ndim - 1))
     tensor._data = stacked
     _mark_stacked(tensor)
     return _FinishedTask(tensor)
@@ -311,7 +397,7 @@ def reduce_scatter(tensor: Tensor, tensor_list=None, op=ReduceOp.SUM,
 
         local = jnp.stack([t._data for t in tensor_list]) \
             if tensor_list else tensor._data
-        gathered = _mp_allgather(local)          # [P, P, ...chunk]
+        gathered = _mp_allgather(local, kind="reduce_scatter")  # [P,P,...]
         red = _REDUCERS[op](gathered, 0)         # [P, ...chunk]
         tensor._data = jnp.asarray(red[jax.process_index()])
         return _FinishedTask(tensor)
@@ -333,7 +419,7 @@ def reduce_scatter(tensor: Tensor, tensor_list=None, op=ReduceOp.SUM,
     fn = _compiled("reduce_scatter", g.id,
                    tuple(int(s) for s in stacked.shape), str(stacked.dtype),
                    op)
-    out = fn(stacked)
+    out = _run_compiled("reduce_scatter", g, fn, stacked)
     tensor._data = out
     _mark_stacked(tensor)
     return _FinishedTask(tensor)
@@ -349,7 +435,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
         me = jax.process_index()
         local = jnp.stack([t._data for t in in_tensor_list])   # [P, ...]
-        gathered = _mp_allgather(local)                        # [P, P, ...]
+        gathered = _mp_allgather(local, kind="alltoall")       # [P, P, ...]
         result = [Tensor(jnp.asarray(gathered[src, me]))
                   for src in range(gathered.shape[0])]
         if out_tensor_list is not None:
@@ -368,7 +454,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
              *per_rank.shape[2:])) if per_rank.ndim > 1 else per_rank
     fn = _compiled("alltoall", g.id, tuple(int(s) for s in stacked.shape),
                    str(stacked.dtype), None)
-    out = fn(stacked)
+    out = _run_compiled("alltoall", g, fn, stacked)
     chunks = out.reshape((g.nranks, g.nranks, -1) + out.shape[2:])
     result = [Tensor(chunks[i, i]) for i in range(g.nranks)]
     if out_tensor_list is not None:
@@ -413,8 +499,16 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
         p2p.mp_send(tensor._data, jax.process_index(), int(dst),
                     _group(group).id)
         return _FinishedTask(tensor)
-    key = _group(group).id
-    _mailbox.setdefault(key, collections.deque()).append(tensor._data)
+    g = _group(group)
+    _mailbox.setdefault(g.id, collections.deque()).append(tensor._data)
+    if _obs.enabled():
+        import time as _time
+
+        arr = tensor._data
+        _obs.comms.record("send_recv", nranks=2,
+                          nbytes=_per_rank_bytes(arr, 1),
+                          t0=_time.perf_counter(), wall_s=0.0, group=g.id,
+                          op="send", dst=int(dst))
     return _FinishedTask(tensor)
 
 
@@ -445,13 +539,21 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True):
         _check_recv_match(tensor, arr, src)
         tensor._data = jnp.asarray(arr)
         return _FinishedTask(tensor)
-    queue = _mailbox.get(_group(group).id)
+    g = _group(group)
+    queue = _mailbox.get(g.id)
     if not queue:
         raise RuntimeError(
             f"recv(src={src}): no matching send posted (group "
-            f"{_group(group).id}). In single-controller mode send() must "
+            f"{g.id}). In single-controller mode send() must "
             f"run before the matching recv().")
     tensor._data = queue.popleft()
+    if _obs.enabled():
+        import time as _time
+
+        _obs.comms.record("send_recv", nranks=2,
+                          nbytes=_per_rank_bytes(tensor._data, 1),
+                          t0=_time.perf_counter(), wall_s=0.0, group=g.id,
+                          op="recv", src=int(src))
     return _FinishedTask(tensor)
 
 
@@ -557,11 +659,15 @@ def p2p_shift(tensor: Tensor, offset: int = 1, group=None) -> Tensor:
 def barrier(group=None):
     """Block until all ranks arrive (reference barrier collective), guarded
     by the comm watchdog (`watchdog.py`, CommTaskManager analog)."""
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
     from .watchdog import watchdog_guard
 
+    trace = _obs.enabled()
+    t0 = _time.perf_counter() if trace else 0.0
     if _multiproc():
         if group is not None:
             raise NotImplementedError(
@@ -571,6 +677,10 @@ def barrier(group=None):
 
         with watchdog_guard("barrier"):
             multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        if trace:
+            _obs.comms.record("barrier", nranks=jax.process_count(),
+                              nbytes=0, t0=t0,
+                              wall_s=_time.perf_counter() - t0)
         return _FinishedTask(None)
     with watchdog_guard("barrier"):
         jax.effects_barrier()
@@ -578,6 +688,9 @@ def barrier(group=None):
         jax.block_until_ready(
             jax.device_put(jnp.zeros(g.nranks),
                            _group_sharding(g, 0)))
+    if trace:
+        _obs.comms.record("barrier", nranks=g.nranks, nbytes=0, t0=t0,
+                          wall_s=_time.perf_counter() - t0, group=g.id)
     return _FinishedTask(None)
 
 

@@ -24,10 +24,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from typing import Dict, Tuple
 
 import numpy as np
 
+from ... import observability as _obs
 from ...framework import flags
 
 # Stay well under the coordination service's gRPC frame limit.
@@ -82,6 +84,8 @@ def mp_send(arr, src: int, dst: int, gid: int = 0) -> None:
     seq = _next_seq(gid, src, dst)
     base = f"ptpu_p2p/{gid}/{src}-{dst}/{seq}"
     raw = a.tobytes()
+    trace = _obs.enabled()
+    t0 = _time.perf_counter() if trace else 0.0
     n_chunks = max(1, (len(raw) + _CHUNK_BYTES - 1) // _CHUNK_BYTES)
     for i in range(n_chunks):
         c.key_value_set_bytes(f"{base}/c{i}",
@@ -90,6 +94,10 @@ def mp_send(arr, src: int, dst: int, gid: int = 0) -> None:
     c.key_value_set(f"{base}/meta", json.dumps(
         {"dtype": np.dtype(a.dtype).name, "shape": list(a.shape),
          "chunks": n_chunks}))
+    if trace:
+        _obs.comms.record("send_recv", nranks=2, nbytes=len(raw), t0=t0,
+                          wall_s=_time.perf_counter() - t0, group=gid,
+                          op="send", src=src, dst=dst, seq=seq)
 
 
 def mp_recv(src: int, dst: int, gid: int = 0,
@@ -105,6 +113,8 @@ def mp_recv(src: int, dst: int, gid: int = 0,
         seq = _next_seq(gid, src, dst)
     base = f"ptpu_p2p/{gid}/{src}-{dst}/{seq}"
     tmo = _timeout_ms()
+    trace = _obs.enabled()
+    t0 = _time.perf_counter() if trace else 0.0
     try:
         meta = json.loads(c.blocking_key_value_get(f"{base}/meta", tmo))
     except Exception as e:
@@ -127,5 +137,9 @@ def mp_recv(src: int, dst: int, gid: int = 0,
                 c.key_value_delete(key)
             except Exception:
                 pass
+    if trace:
+        _obs.comms.record("send_recv", nranks=2, nbytes=len(raw), t0=t0,
+                          wall_s=_time.perf_counter() - t0, group=gid,
+                          op="recv", src=src, dst=dst, seq=seq)
     dt = np.dtype(dtype_mod.to_np(meta["dtype"]))
     return np.frombuffer(raw, dtype=dt).reshape(meta["shape"])

@@ -1,6 +1,8 @@
 """Stream-ordered collective variants (reference
 `python/paddle/distributed/communication/stream/`). XLA dispatch is already
-device-stream-ordered, so these alias the synchronous implementations."""
+device-stream-ordered, so these alias the synchronous implementations —
+which also means the stream variants inherit the collective tracing
+(`observability/comms.py`) from the aliased functions for free."""
 from .collective import (all_gather, all_reduce, alltoall,  # noqa: F401
                          alltoall_single, broadcast, recv, reduce,
                          reduce_scatter, scatter, send)

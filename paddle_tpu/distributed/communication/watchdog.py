@@ -6,6 +6,14 @@ an async monitor that detects a collective stuck past its timeout, dumps
 diagnostics, and (like the NCCL watchdog) can kill the process so the
 launcher's failure detection / elastic restart takes over
 (`launch/main.py` watcher).
+
+A trip is observable, not just fatal (ISSUE 9 satellite — a hang used to
+diagnose nothing): it bumps the ``comm.watchdog_trips`` counter and
+writes a ``flight_comm_watchdog_*.jsonl`` forensics dump naming the
+stuck collective's kind/group/bytes plus the recent comm-trace ring
+(`observability.comms.dump_watchdog_trip`). The clock and the wait
+primitive are injectable so tests exercise the trip path with zero
+sleeps.
 """
 from __future__ import annotations
 
@@ -14,7 +22,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Optional
+from typing import Callable, Optional
 
 from ...framework import flags
 
@@ -28,22 +36,33 @@ __all__ = ["CommWatchdog", "watchdog_guard"]
 
 
 class CommWatchdog:
-    """Monitors one in-flight communication op (CommTask analog)."""
+    """Monitors one in-flight communication op (CommTask analog).
+
+    `meta` carries what the trip dump should name about the collective
+    (payload bytes, group id); `clock`/`wait` are injectable for
+    zero-sleep tests — `wait(timeout)` must return True when the op
+    finished in time and False on timeout (the `threading.Event.wait`
+    contract)."""
 
     def __init__(self, op_name: str, timeout: Optional[float] = None,
-                 action: Optional[str] = None):
+                 action: Optional[str] = None, meta: Optional[dict] = None,
+                 clock: Callable[[], float] = time.time,
+                 wait: Optional[Callable[[float], bool]] = None):
         self.op_name = op_name
         self.timeout = (flags.flag_value("comm_timeout_s")
                         if timeout is None else float(timeout))
         self.action = action or flags.flag_value("comm_timeout_action")
+        self.meta = dict(meta or {})
+        self._clock = clock
         self._done = threading.Event()
+        self._wait = wait if wait is not None else self._done.wait
         self._thread = None
         self.started_at = None
 
     def start(self):
         if not self.timeout or self.timeout <= 0:
             return self
-        self.started_at = time.time()
+        self.started_at = self._clock()
         self._thread = threading.Thread(target=self._watch, daemon=True)
         self._thread.start()
         return self
@@ -52,14 +71,38 @@ class CommWatchdog:
         self._done.set()
 
     def _watch(self):
-        if self._done.wait(self.timeout):
+        if self._wait(self.timeout):
             return
-        elapsed = time.time() - self.started_at
+        self._trip()
+
+    def _trip(self):
+        """The timeout path: diagnostics first (counter + forensics dump
+        + stacks), THEN the configured action. Split out of `_watch` so
+        tests drive it synchronously with an injected non-waiting
+        `wait`."""
+        from ...framework import monitor
+
+        started = self.started_at if self.started_at is not None \
+            else self._clock()
+        elapsed = self._clock() - started
         rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+        monitor.inc("comm.watchdog_trips")
+        try:
+            from ... import observability as _obs
+
+            self.meta.setdefault("group", 0)
+            self.meta["elapsed_s"] = round(elapsed, 1)
+            self.meta["timeout_s"] = self.timeout
+            self.meta["rank"] = rank
+            _obs.comms.dump_watchdog_trip(self.op_name, self.meta)
+        except Exception:
+            pass   # forensics must never mask the hang diagnostics
         sys.stderr.write(
             f"[paddle_tpu comm watchdog] rank {rank}: collective "
             f"'{self.op_name}' stuck for {elapsed:.1f}s "
-            f"(timeout {self.timeout}s). Stacks:\n")
+            f"(timeout {self.timeout}s, "
+            f"bytes={self.meta.get('bytes', '?')}, "
+            f"group={self.meta.get('group', '?')}). Stacks:\n")
         for tid, frame in sys._current_frames().items():
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))
@@ -78,10 +121,11 @@ class CommWatchdog:
 
 
 def watchdog_guard(op_name: str, timeout: Optional[float] = None,
-                   action: Optional[str] = None) -> CommWatchdog:
+                   action: Optional[str] = None,
+                   meta: Optional[dict] = None) -> CommWatchdog:
     """Context manager guarding one collective call:
 
-    with watchdog_guard("all_reduce"):
+    with watchdog_guard("all_reduce", meta={"bytes": payload_bytes}):
         <blocking collective>
     """
-    return CommWatchdog(op_name, timeout, action)
+    return CommWatchdog(op_name, timeout, action, meta=meta)

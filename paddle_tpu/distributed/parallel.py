@@ -48,6 +48,13 @@ def init_parallel_env() -> Optional[Group]:
     if get_mesh() is None:
         set_mesh(ProcessMesh(np.arange(jax.device_count()), ["world"]))
     _initialized[0] = True
+    # topology gauges: the identity half of the mesh-aware aggregation
+    # (`monitor.aggregate_mesh`) — who this host is, how many peers
+    from ..framework import monitor
+
+    monitor.set_gauge("mesh.hosts", jax.process_count())
+    monitor.set_gauge("mesh.host_rank", jax.process_index())
+    monitor.set_gauge("mesh.devices", jax.device_count())
     return _get_global_group()
 
 

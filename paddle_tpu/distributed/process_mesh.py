@@ -100,6 +100,17 @@ class ProcessMesh:
         return (f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names},"
                 f" process_ids={self.process_ids})")
 
+    def describe(self) -> dict:
+        """Observability view of the topology: shape/axes/size plus the
+        process (host) span — what the "Mesh:" profiler section and the
+        multichip bench report print."""
+        import jax
+
+        return {"shape": self.shape, "dim_names": self.dim_names,
+                "size": self.size,
+                "processes": jax.process_count(),
+                "local_devices": len(jax.local_devices())}
+
     # -- the XLA side -------------------------------------------------------
     def to_jax_mesh(self):
         return _jax_mesh_cached(self._mesh.tobytes(), self._mesh.shape,

@@ -16,6 +16,13 @@ dict (histograms expand Prometheus-style into `_bucket{le=...}` /
 exposition format (`tools/metrics_dump.py` is the CLI). The serving
 metrics module and every `profiler.summary()` section builder scrape
 through `snapshot()` instead of ad-hoc attribute walks.
+
+Mesh-aware aggregation (ISSUE 9): :func:`aggregate_mesh` is the
+coordinator-side cross-host view — every host's `snapshot()` rides an
+`all_gather_object`, counters are summed, per-host step walls
+(`mesh.step_wall_ms` gauge, set by the training/serving loop) yield the
+straggler attribution (`mesh.straggler_host` gauge + spread histogram).
+`tools/metrics_dump.py --mesh` is the CLI.
 """
 from __future__ import annotations
 
@@ -24,8 +31,8 @@ from typing import Dict, Iterable, Optional, Sequence
 
 __all__ = ["register_counter", "counter", "inc", "set_value", "set_max",
            "set_gauge", "observe", "histogram", "get", "get_all",
-           "snapshot", "render_prometheus", "reset", "reset_prefix",
-           "reset_all", "Counter", "Histogram"]
+           "snapshot", "aggregate_mesh", "render_prometheus", "reset",
+           "reset_prefix", "reset_all", "Counter", "Histogram"]
 
 
 class Counter:
@@ -210,6 +217,79 @@ def snapshot(prefix: Optional[str] = None,
     for k, h in hists:
         if prefix is None or k.startswith(prefix):
             out.update(h.snapshot())
+    return out
+
+
+_STEP_WALL_SPREAD_BUCKETS = (1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
+                             5000.0)
+
+
+def aggregate_mesh(prefix: Optional[str] = None,
+                   snapshots: Optional[list] = None,
+                   step_wall_key: str = "mesh.step_wall_ms") -> Dict:
+    """Coordinator-side cross-host aggregation of `snapshot()`.
+
+    Every host contributes its local snapshot via
+    `distributed.all_gather_object` (single-controller: the one process
+    plays every rank, so the view is N copies of this host — the same
+    emulation convention the collectives use). Numeric values are
+    summed into ``sum``; each host's `step_wall_key` gauge (set by its
+    training/serving loop after timing a step) feeds the straggler
+    attribution: the slowest host lands in the ``mesh.straggler_host``
+    gauge, per-host walls feed the ``mesh.step_wall_spread`` histogram,
+    and ``mesh.step_wall_spread_pct`` is ``(max/min - 1) * 100``.
+
+    `snapshots` overrides the gather with a pre-collected per-host list
+    (tests; offline aggregation of scraped dumps). Under a
+    single-controller process the gather is skipped outright — the
+    emulated `all_gather_object` would return N identical copies of this
+    process, and summing those would inflate every counter N-fold while
+    reporting device count as "hosts".
+    """
+    if snapshots is None:
+        local = snapshot(prefix, include_histograms=False)
+        if step_wall_key not in local:
+            w = get(step_wall_key)
+            if w:
+                local[step_wall_key] = w
+        import jax
+
+        if jax.process_count() > 1:
+            from ..distributed.communication.collective import \
+                all_gather_object
+
+            gathered: list = []
+            all_gather_object(gathered, local)
+            snapshots = [dict(s) for s in gathered]
+        else:
+            snapshots = [local]
+    hosts = len(snapshots)
+    agg: Dict[str, float] = {}
+    for s in snapshots:
+        for k, v in s.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                agg[k] = agg.get(k, 0) + v
+    walls = [float(s.get(step_wall_key, 0) or 0) for s in snapshots]
+    out: Dict[str, object] = {"hosts": hosts,
+                              "per_host_step_wall_ms": walls,
+                              "sum": agg}
+    set_gauge("mesh.hosts", hosts)
+    inc("mesh.aggregations")   # the "Mesh:" profiler section's trigger
+    if any(walls):
+        straggler = max(range(hosts), key=lambda r: walls[r])
+        out["straggler_host"] = straggler
+        out["straggler_step_wall_ms"] = walls[straggler]
+        nonzero = [w for w in walls if w > 0]
+        spread_pct = round((max(nonzero) / min(nonzero) - 1.0) * 100.0, 2)
+        out["step_wall_spread_pct"] = spread_pct
+        set_gauge("mesh.straggler_host", straggler)
+        set_gauge("mesh.step_wall_spread_pct", spread_pct)
+        for w in walls:
+            observe("mesh.step_wall_spread", w,
+                    buckets=_STEP_WALL_SPREAD_BUCKETS)
+    else:
+        out["straggler_host"] = None
+        out["step_wall_spread_pct"] = None
     return out
 
 

@@ -74,6 +74,17 @@ class BlockCacheManager:
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: Dict[int, List[int]] = {}
         self._lens: Dict[int, int] = {}
+        self._guard_ids: set = set()   # guard seqs, so utilization() is
+        #                                O(#guards) on the admission path
+        # memory observability registry (weak; same sys.modules guard
+        # pattern as _chaos — processes that never import observability
+        # pay one dict lookup at construction, nothing per op)
+        mod = _sys.modules.get("paddle_tpu.observability.memory")
+        if mod is not None:
+            try:
+                mod.register_kv_manager(self)
+            except Exception:
+                pass
 
     @property
     def free_blocks(self) -> int:
@@ -83,9 +94,79 @@ class BlockCacheManager:
     def num_seqs(self) -> int:
         return len(self._tables)
 
+    @staticmethod
+    def _is_guard(seq_id) -> bool:
+        """Guard/infrastructure sequences hold sacrificial padding blocks
+        (the serving scheduler leases them under negative seq ids); they
+        are capacity overhead, not load."""
+        return isinstance(seq_id, int) and seq_id < 0
+
+    def _guard_blocks(self) -> int:
+        return sum(len(self._tables[sid]) for sid in self._guard_ids)
+
     def utilization(self) -> float:
-        """Fraction of the physical pool currently held by sequences."""
-        return (self.num_blocks - len(self._free)) / max(self.num_blocks, 1)
+        """Fraction of the usable pool currently held by REAL sequences.
+
+        Guard blocks are excluded from both sides of the ratio: they are
+        leased forever, so counting them as "used" put a permanent floor
+        under apparent utilization and skewed the admission-control KV
+        watermarks (PR 6) exactly when pools are small."""
+        guard = self._guard_blocks()
+        used = self.num_blocks - len(self._free) - guard
+        return used / max(self.num_blocks - guard, 1)
+
+    def fragmentation(self) -> Dict:
+        """Fragmentation view of the pool (observability/memory.py):
+
+        - per-sequence leased-vs-used blocks and token counts (`per_seq`);
+        - token-level internal fragmentation: leased block capacity vs
+          tokens actually stored (partial last blocks);
+        - free-list shape: largest contiguous run of free block ids and
+          the fragmentation ratio `1 - largest_run / free` (0.0 = one
+          clean run, →1.0 = free space shattered into single blocks —
+          irrelevant to correctness here because blocks are
+          position-indexed, but the predictor of allocator behavior on
+          backends with contiguous KV layouts).
+        """
+        free = sorted(self._free)
+        largest_run = run = 0
+        prev = None
+        for b in free:
+            run = run + 1 if prev is not None and b == prev + 1 else 1
+            largest_run = max(largest_run, run)
+            prev = b
+        per_seq = {}
+        leased = used = tokens = guard = 0
+        for sid, table in self._tables.items():
+            if self._is_guard(sid):
+                guard += len(table)
+                continue
+            n_leased = len(table)
+            n_used = min(n_leased, self.blocks_needed(self._lens[sid]))
+            per_seq[sid] = {"leased_blocks": n_leased,
+                            "used_blocks": n_used,
+                            "tokens": self._lens[sid]}
+            leased += n_leased
+            used += n_used
+            tokens += self._lens[sid]
+        capacity_tokens = leased * self.block_size
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free_blocks": len(free),
+            "guard_blocks": guard,
+            "leased_blocks": leased,
+            "used_blocks": used,
+            "tokens": tokens,
+            "utilization": round(self.utilization(), 4),
+            "internal_frag_ratio": round(
+                1.0 - tokens / capacity_tokens, 4) if capacity_tokens
+            else 0.0,
+            "largest_free_run": largest_run,
+            "free_fragmentation_ratio": round(
+                1.0 - largest_run / len(free), 4) if free else 0.0,
+            "per_seq": per_seq,
+        }
 
     def blocks_needed(self, num_tokens: int) -> int:
         return max(1, (num_tokens + self.block_size - 1) // self.block_size)
@@ -111,6 +192,8 @@ class BlockCacheManager:
         blocks = [self._free.pop() for _ in range(need)]
         self._tables[seq_id] = blocks
         self._lens[seq_id] = num_tokens
+        if self._is_guard(seq_id):
+            self._guard_ids.add(seq_id)
         return blocks
 
     def append_token(self, seq_id: int) -> None:
@@ -161,6 +244,7 @@ class BlockCacheManager:
         for b in self._tables.pop(seq_id):
             self._free.append(b)
         self._lens.pop(seq_id)
+        self._guard_ids.discard(seq_id)
 
     def seq_len(self, seq_id: int) -> int:
         return self._lens[seq_id]

@@ -25,6 +25,15 @@ that lets every perf claim be *derived* instead of asserted:
 - **Bench baseline store** (`baseline.py`, stdlib-only): per-scenario
   per-platform last-good results under `profiler_log/baselines/`,
   compared by `tools/bench_diff.py` (>5 % regression fails).
+- **Collective tracing + overlap accounting** (`comms.py`): every eager
+  collective records kind/group/bytes/wall/algbw into a bounded ring +
+  `comm.<kind>.*` counters; `step_overlap` turns a step window into an
+  exposed-comm-ms + overlap-efficiency report, and `hlo_comm_census`
+  reports the comm volume of compiled (GSPMD) executables.
+- **HBM + KV telemetry, OOM forensics** (`memory.py`): per-device
+  live/peak bytes, paged-KV fragmentation snapshots, and the
+  `flight_oom_*.jsonl` dump on KV exhaustion / backend allocation
+  failure.
 
 Everything is OFF by default and costs nothing while off: instrumented
 sites check one module-level bool (`enabled()`); no span is allocated, no
@@ -33,9 +42,10 @@ signature is built, and `cost_analysis()` is never invoked when disabled
 """
 from __future__ import annotations
 
-from . import compile_trace, costs, timeline
+from . import comms, compile_trace, costs, memory, timeline
 from .baseline import BaselineStore, compare_reports
 from .compile_trace import CompileRecord, compiles, retrace_causes
+from .comms import CommRecord, hlo_comm_census, overlap_report, step_overlap
 from .costs import CostBook, CostCard, cost_book
 from .timeline import (dispatch_span, dump_flight, events, flight_events,
                        request_event)
@@ -47,6 +57,7 @@ __all__ = [
     "request_event", "dispatch_span", "events", "flight_events",
     "dump_flight",
     "BaselineStore", "compare_reports",
+    "CommRecord", "step_overlap", "overlap_report", "hlo_comm_census",
 ]
 
 _enabled = False
@@ -77,3 +88,5 @@ def reset():
     compile_trace.reset()
     costs.reset()
     timeline.reset()
+    comms.reset()
+    memory.reset()

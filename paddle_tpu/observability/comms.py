@@ -1,0 +1,418 @@
+"""Collective tracing + compute/comm overlap accounting.
+
+ROADMAP item 3 (TP-sharded multichip serving with T3-style overlap)
+cannot be attacked while the distributed stack is unobservable: before
+this module, `dryrun_multichip` printed five "OK" lines and recorded
+nothing about bytes moved, collective wall time, or the comm-exposed
+fraction of a step. Two producers feed it:
+
+- **Eager collectives** (`distributed/communication/collective.py`,
+  `p2p.py`): every host-blocking all_reduce / all_gather /
+  reduce_scatter / alltoall / broadcast / scatter / ppermute /
+  send_recv / barrier records kind, group, per-rank payload bytes, wall
+  time, and the derived *algorithmic bandwidth*
+  ``bytes * (n-1)/n / wall`` into a bounded ring plus monitor counters
+  (``comm.<kind>.{calls,bytes,wall_ms}``, ``comm.<kind>.algbw_gbs``
+  gauge, shared ``comm.wall_ms`` histogram).
+- **Compiled programs**: GSPMD/shard_map collectives live inside XLA
+  executables and cannot be timed per-call from the host;
+  :func:`hlo_comm_census` instead parses the compiled HLO for
+  collective instructions and reports op counts + payload bytes — the
+  comm *volume* of a sharded step, from what XLA actually compiled.
+
+**Overlap accounting** is the yardstick every future T3-style kernel
+change must move: :func:`step_overlap` wraps one step and combines the
+step wall with the collective wall traced inside the window into an
+exposed-comm ms/step + overlap-efficiency gauge
+(:func:`overlap_report` is the bare arithmetic). Host-blocking eager
+collectives are fully exposed by construction; collectives XLA
+scheduled inside a compiled program contribute volume (census) but no
+exposed wall — which is exactly the desired end state.
+
+Everything here is inert until `observability.enable()`: the collective
+hot paths check the one enable bool before building any record
+(asserted by tests/test_observability_dist.py).
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+__all__ = ["CommRecord", "configure", "record", "records", "totals",
+           "aggregate_algbw_gbs", "mark", "wall_since", "calls_since",
+           "earliest_t0", "step_overlap", "overlap_report",
+           "hlo_comm_census", "chrome_events", "dump_watchdog_trip",
+           "summary_lines", "reset"]
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=4096)
+_steps: deque = deque(maxlen=512)     # (label, t0, t1, comm_wall_s)
+_total_wall_s = 0.0
+_total_calls = 0
+
+_WALL_MS_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 100.0, 1000.0)
+
+
+def configure(capacity: Optional[int] = None,
+              flight_dir: Optional[str] = None):
+    """`flight_dir` forwards to the ONE flight-recorder directory
+    (`timeline.configure`) — every forensics producer shares it, so one
+    incident's dumps never scatter across directories."""
+    global _ring
+    if flight_dir is not None:
+        from . import timeline
+
+        timeline.configure(flight_dir=flight_dir)
+    with _lock:
+        if capacity is not None and capacity != _ring.maxlen:
+            _ring = deque(_ring, maxlen=capacity)
+
+
+def reset():
+    global _total_wall_s, _total_calls
+    with _lock:
+        _ring.clear()
+        _steps.clear()
+        _total_wall_s = 0.0
+        _total_calls = 0
+
+
+class CommRecord:
+    """One traced collective call."""
+
+    __slots__ = ("kind", "group", "nranks", "nbytes", "t0", "wall_s",
+                 "algbw_gbs", "meta")
+
+    def __init__(self, kind, group, nranks, nbytes, t0, wall_s, algbw_gbs,
+                 meta):
+        self.kind = kind
+        self.group = group
+        self.nranks = nranks
+        self.nbytes = nbytes
+        self.t0 = t0
+        self.wall_s = wall_s
+        self.algbw_gbs = algbw_gbs
+        self.meta = meta
+
+    def as_dict(self) -> dict:
+        d = {"kind": self.kind, "group": self.group, "nranks": self.nranks,
+             "bytes": self.nbytes, "t0": self.t0,
+             "wall_ms": round(self.wall_s * 1e3, 4),
+             "algbw_gbs": self.algbw_gbs}
+        if self.meta:
+            d["meta"] = self.meta
+        return d
+
+    def __repr__(self):
+        return (f"CommRecord({self.kind} n={self.nranks} "
+                f"{self.nbytes}B {self.wall_s * 1e3:.3f}ms "
+                f"{self.algbw_gbs}GB/s)")
+
+
+def record(kind: str, nranks: int, nbytes: int, t0: float, wall_s: float,
+           group: int = 0, **meta) -> CommRecord:
+    """Record one collective call (producer sites gate on
+    `observability.enabled()` BEFORE computing any argument — this
+    function is never reached on the disabled path). `nbytes` is the
+    per-rank payload; the bandwidth gauge is ``bytes * (n-1)/n / wall``
+    — the per-rank ring-transfer traffic (what nccl-tests calls *busbw*
+    for all_gather/reduce_scatter; an all_reduce ring moves 2x this).
+    One convention across kinds, built for tracking THIS stack against
+    its own baseline — not for absolute cross-stack comparisons."""
+    from ..framework import monitor
+
+    global _total_wall_s, _total_calls
+    n = max(int(nranks), 1)
+    nbytes = int(nbytes)
+    algbw = (nbytes * (n - 1) / n / wall_s / 1e9
+             if wall_s > 0 and nbytes > 0 and n > 1 else 0.0)
+    # 4 significant digits, not 4 decimals: CPU-toy payloads live far
+    # below 1e-4 GB/s and must not round to a broken-looking 0
+    rec = CommRecord(kind, int(group), n, nbytes, t0, wall_s,
+                     float(f"{algbw:.4g}"), meta or None)
+    with _lock:
+        _ring.append(rec)
+        _total_wall_s += wall_s
+        _total_calls += 1
+    monitor.inc(f"comm.{kind}.calls")
+    monitor.inc(f"comm.{kind}.bytes", nbytes)
+    monitor.inc(f"comm.{kind}.wall_ms", round(wall_s * 1e3, 6))
+    monitor.set_gauge(f"comm.{kind}.algbw_gbs", rec.algbw_gbs)
+    monitor.observe("comm.wall_ms", wall_s * 1e3, buckets=_WALL_MS_BUCKETS)
+    return rec
+
+
+def records() -> List[CommRecord]:
+    with _lock:
+        return list(_ring)
+
+
+def totals() -> Dict[str, dict]:
+    """Per-kind aggregate over the ring: calls, bytes, wall_ms."""
+    out: Dict[str, dict] = {}
+    for r in records():
+        e = out.setdefault(r.kind, {"calls": 0, "bytes": 0, "wall_ms": 0.0})
+        e["calls"] += 1
+        e["bytes"] += r.nbytes
+        e["wall_ms"] = round(e["wall_ms"] + r.wall_s * 1e3, 4)
+    return out
+
+
+def aggregate_algbw_gbs() -> float:
+    """One algorithmic-bandwidth number over every traced collective:
+    sum of per-call ``bytes * (n-1)/n`` divided by total collective
+    wall. 0.0 when nothing (or only zero-byte ops) was traced."""
+    eff_bytes = 0.0
+    wall = 0.0
+    for r in records():
+        eff_bytes += r.nbytes * (r.nranks - 1) / max(r.nranks, 1)
+        wall += r.wall_s
+    return float(f"{eff_bytes / wall / 1e9:.4g}") if wall > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# overlap accounting
+# ---------------------------------------------------------------------------
+
+
+def earliest_t0() -> Optional[float]:
+    """Earliest timestamp across collective records AND step-overlap
+    windows — the chrome exporter folds this into its clock base so a
+    window that opens before the first recorded event cannot render at
+    negative ts."""
+    with _lock:
+        ts = [r.t0 for r in _ring] + [s[1] for s in _steps]
+    return min(ts) if ts else None
+
+
+def mark():
+    """Cursor into the trace (calls, accumulated wall) — take one before
+    a step, pass to :func:`wall_since` after, to get the collective wall
+    spent inside the window."""
+    with _lock:
+        return (_total_calls, _total_wall_s)
+
+
+def wall_since(m) -> float:
+    with _lock:
+        return _total_wall_s - m[1]
+
+
+def calls_since(m) -> int:
+    with _lock:
+        return _total_calls - m[0]
+
+
+def overlap_report(step_wall_s: float, comm_wall_s: float,
+                   flops: Optional[float] = None,
+                   peak_flops: Optional[float] = None,
+                   label: Optional[str] = None) -> dict:
+    """Comm-exposed fraction of one step: host-blocking collective wall
+    (`comm_wall_s`, clamped to the step) against the step wall.
+    `overlap_efficiency` is 1.0 when no comm time is exposed (fully
+    overlapped, or no comm) and 0.0 when the step is all exposed comm —
+    the gauge a T3-style decomposition must push toward 1.0. With
+    `flops` (CostBook/XLA) and `peak_flops` the report also carries the
+    ideal compute time so the comm headroom is visible."""
+    from ..framework import monitor
+
+    step_ms = step_wall_s * 1e3
+    exposed_ms = min(max(comm_wall_s, 0.0), max(step_wall_s, 0.0)) * 1e3
+    frac = exposed_ms / step_ms if step_ms > 0 else 0.0
+    out = {"step_ms": round(step_ms, 3),
+           "comm_ms": round(comm_wall_s * 1e3, 3),
+           "exposed_ms": round(exposed_ms, 3),
+           "comm_exposed_fraction": round(frac, 4),
+           "overlap_efficiency": round(1.0 - frac, 4)}
+    if label:
+        out["label"] = label
+    if flops and peak_flops:
+        ideal_ms = flops / peak_flops * 1e3
+        out["ideal_compute_ms"] = round(ideal_ms, 3)
+        if step_ms > 0:
+            out["compute_fraction_ideal"] = round(
+                min(ideal_ms / step_ms, 1.0), 4)
+    monitor.set_gauge("comm.exposed_ms_per_step", out["exposed_ms"])
+    monitor.set_gauge("comm.overlap_efficiency", out["overlap_efficiency"])
+    return out
+
+
+@contextmanager
+def step_overlap(label: str = "step", flops: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
+    """Measure one step window: yields a dict filled on exit with the
+    :func:`overlap_report` of (step wall, collective wall traced inside
+    the window). The window is also kept as a step span for the chrome
+    `comms` track, so collectives render correlated with the step that
+    issued them. Callers gate on `observability.enabled()`."""
+    m = mark()
+    t0 = time.perf_counter()
+    box: dict = {}
+    try:
+        yield box
+    finally:
+        wall = time.perf_counter() - t0
+        comm = wall_since(m)
+        box.update(overlap_report(wall, comm, flops=flops,
+                                  peak_flops=peak_flops, label=label))
+        box["comm_calls"] = calls_since(m)
+        with _lock:
+            _steps.append((label, t0, t0 + wall, comm))
+
+
+# ---------------------------------------------------------------------------
+# compiled-program comm census (GSPMD / shard_map collectives)
+# ---------------------------------------------------------------------------
+
+_HLO_COLLECTIVES = {
+    "all-reduce": "all_reduce",
+    "all-gather": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "alltoall",
+    "collective-permute": "ppermute",
+    "collective-broadcast": "broadcast",
+}
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+_RESULT_OP_RE = re.compile(
+    r"((?:\([^)]*\))|(?:[a-z]+[0-9]*\[[^\]]*\](?:\{[^}]*\})?))\s+"
+    r"([a-z][\w-]*)\(")
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "f8": 1,
+                "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    itemsize = _DTYPE_BYTES.get(dtype, 4)
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * itemsize
+
+
+def hlo_comm_census(hlo_text: str) -> Dict[str, dict]:
+    """Scan compiled HLO text for collective instructions and return
+    ``{kind: {"ops", "bytes"}}`` — the comm volume of the executable,
+    from result shapes (async ``-start`` forms count once; ``-done``
+    forms are ignored). This is how a GSPMD-sharded step's collectives
+    are made visible without per-call host timing."""
+    out: Dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        if " = " not in line:
+            continue
+        m = _RESULT_OP_RE.match(line.split(" = ", 1)[1])
+        if m is None:
+            continue
+        op = m.group(2)
+        is_start = op.endswith("-start")
+        base = op[:-6] if is_start else op
+        kind = _HLO_COLLECTIVES.get(base)
+        if kind is None:
+            continue
+        shapes = _SHAPE_RE.findall(m.group(1))
+        if is_start and len(shapes) > 1:
+            # async form: the tuple result carries (operand, destination)
+            # buffers — count only the destination, or the same collective
+            # would report ~2x the bytes of its synchronous form
+            shapes = shapes[-1:]
+        nbytes = sum(_shape_bytes(dt, dims) for dt, dims in shapes)
+        e = out.setdefault(kind, {"ops": 0, "bytes": 0})
+        e["ops"] += 1
+        e["bytes"] += nbytes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# consumers: chrome track, watchdog forensics, profiler section
+# ---------------------------------------------------------------------------
+
+
+def chrome_events(base: Optional[float] = None) -> List[dict]:
+    """Render the ring as chrome://tracing events: pid "comms", tid 0
+    for step-overlap windows, one tid per collective kind — sharing the
+    caller's clock base so collectives line up with host/step spans."""
+    with _lock:
+        recs = list(_ring)
+        steps = list(_steps)
+    if not recs and not steps:
+        return []
+    if base is None:
+        base = min([r.t0 for r in recs] + [s[1] for s in steps])
+    pid = "comms"
+    out: List[dict] = [
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "steps"}}]
+    tid_of = {k: i + 1 for i, k in enumerate(sorted({r.kind for r in recs}))}
+    for k, tid in tid_of.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": k}})
+    for label, t0, t1, comm in steps:
+        out.append({"name": label, "ph": "X", "pid": pid, "tid": 0,
+                    "cat": "step", "ts": (t0 - base) * 1e6,
+                    "dur": (t1 - t0) * 1e6,
+                    "args": {"comm_ms": round(comm * 1e3, 3)}})
+    for r in recs:
+        out.append({"name": r.kind, "ph": "X", "pid": pid,
+                    "tid": tid_of[r.kind], "cat": "comm",
+                    "ts": (r.t0 - base) * 1e6, "dur": r.wall_s * 1e6,
+                    "args": {"bytes": r.nbytes, "group": r.group,
+                             "nranks": r.nranks,
+                             "algbw_gbs": r.algbw_gbs}})
+    return out
+
+
+def dump_watchdog_trip(op_name: str, meta: Optional[dict] = None,
+                       directory: Optional[str] = None) -> Optional[str]:
+    """Comm-watchdog forensics: on a collective timeout, write
+    ``flight_comm_watchdog_<op>_<pid>_<n>.jsonl`` naming the stuck
+    collective (kind/group/bytes) plus the recent comm records and
+    timeline events — a hang now diagnoses itself. Never raises into
+    the watchdog thread."""
+    from . import timeline
+
+    with _lock:
+        recs = [r.as_dict() for r in _ring]
+    # write_flight_file owns filename sanitization
+    return timeline.write_flight_file(
+        f"comm_watchdog_{op_name}",
+        {"reason": f"comm_watchdog_{op_name}",
+         "collective": dict({"kind": op_name}, **(meta or {}))},
+        recs[-256:] + timeline.flight_events()[-64:],
+        directory)
+
+
+def summary_lines() -> List[str]:
+    """The profiler's "Comms:" section body — derived from the exact
+    `comm.<kind>.*` monitor counters, NOT the bounded ring: a run with
+    more collectives than the ring holds must not under-report its
+    totals by whatever fell off the back."""
+    from ..framework import monitor
+
+    snap = monitor.snapshot("comm.", include_histograms=False)
+    per_kind = {k[len("comm."):-len(".calls")]: v
+                for k, v in snap.items()
+                if k.endswith(".calls") and v}
+    if not per_kind:
+        return []
+    g = lambda kind, field: snap.get(f"comm.{kind}.{field}", 0)  # noqa: E731
+    calls = sum(per_kind.values())
+    nbytes = sum(g(k, "bytes") for k in per_kind)
+    wall = sum(g(k, "wall_ms") for k in per_kind)
+    lines = ["",
+             f"Comms: {calls} collectives, {nbytes / 1e6:.2f} MB moved, "
+             f"{wall:.2f} ms wall "
+             f"(exposed {snap.get('comm.exposed_ms_per_step', 0)} ms/step, "
+             f"overlap eff {snap.get('comm.overlap_efficiency', 1.0)})"]
+    for kind in sorted(per_kind):
+        lines.append(
+            f"  {kind}: {per_kind[kind]} calls, "
+            f"{g(kind, 'bytes') / 1e6:.3f} MB, "
+            f"{g(kind, 'wall_ms'):.2f} ms, "
+            f"bw {g(kind, 'algbw_gbs')} GB/s")
+    return lines

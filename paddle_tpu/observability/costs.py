@@ -158,6 +158,8 @@ class CostBook:
                 row = {"name": n, "calls": calls,
                        "wall_ms": round(wall * 1e3, 3),
                        "flops_per_call": card.flops if card else None,
+                       "peak_bytes": card.peak_bytes if card else None,
+                       "temp_bytes": card.temp_bytes if card else None,
                        "achieved_gflops": None}
                 if card and card.flops and wall > 0 and calls:
                     # 3 significant digits: toy CPU shapes live far below

@@ -1,0 +1,164 @@
+"""Per-device HBM + KV-cache fragmentation telemetry and OOM forensics.
+
+The serving stack already knew *that* memory ran out (`KVCacheExhausted`
+is a typed scheduling event); this module records *what the memory
+looked like* when it did:
+
+- :func:`device_memory_snapshot` — per-device live/peak bytes from the
+  backend's PJRT memory stats (`paddle_tpu.device.memory_stats`, which
+  falls back to a live-array walk on backends without allocator stats),
+  published as ``mem.<device>.{live,peak}_bytes`` gauges.
+- KV fragmentation — `BlockCacheManager.fragmentation()`
+  (`inference/cache.py`) reports the per-sequence leased-vs-used block
+  breakdown, free-list fragmentation, and largest contiguous free run;
+  managers self-register here (weakly) so a snapshot can enumerate
+  every live pool without threading references around.
+- :func:`dump_oom` — the forensics dump: on `KVCacheExhausted` under
+  real pressure or a backend allocation failure, the scheduler writes
+  ``profiler_log/flight_oom_<reason>_<pid>_<n>.jsonl`` with the device
+  memory snapshot, the KV map, the top executables by compiler-reported
+  peak bytes (CostBook `memory_analysis`), the live request set, and
+  the recent timeline ring. Rate-limited (an exhaustion storm must not
+  turn into a disk storm) and it never raises into the serving path.
+
+Inert until `observability.enable()`: the producers gate on the one
+enable bool; manager registration is a weak-set add at construction
+time (not on any hot path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import List, Optional
+
+__all__ = ["configure", "register_kv_manager", "kv_managers",
+           "device_memory_snapshot", "kv_snapshot", "memory_report",
+           "dump_oom", "reset"]
+
+_lock = threading.Lock()
+_kv_managers: "weakref.WeakSet" = weakref.WeakSet()
+_last_dump_t: Optional[float] = None
+_min_dump_interval_s = 30.0
+
+
+def configure(flight_dir: Optional[str] = None,
+              min_dump_interval_s: Optional[float] = None):
+    """`flight_dir` forwards to the ONE flight-recorder directory
+    (`timeline.configure`) shared by every forensics producer."""
+    global _min_dump_interval_s
+    if flight_dir is not None:
+        from . import timeline
+
+        timeline.configure(flight_dir=flight_dir)
+    with _lock:
+        if min_dump_interval_s is not None:
+            _min_dump_interval_s = float(min_dump_interval_s)
+
+
+def reset():
+    """Drop the rate-limiter state (tests); registered managers stay —
+    they unregister themselves by dying (weak refs)."""
+    global _last_dump_t
+    with _lock:
+        _last_dump_t = None
+
+
+def register_kv_manager(manager) -> None:
+    """Weakly track a `BlockCacheManager` so memory snapshots can
+    enumerate every live KV pool. Called from the manager's constructor
+    via a sys.modules guard — processes that never import observability
+    pay nothing."""
+    with _lock:
+        _kv_managers.add(manager)
+
+
+def kv_managers() -> List:
+    with _lock:
+        return list(_kv_managers)
+
+
+def device_memory_snapshot(set_gauges: bool = True) -> List[dict]:
+    """Per-device live/peak bytes (backend stats, live-array fallback),
+    optionally published as ``mem.<device>.*`` gauges."""
+    import jax
+
+    from .. import device as dev_api
+    from ..framework import monitor
+
+    out = []
+    for d in jax.local_devices():
+        st = dev_api.memory_stats(d)
+        row = {"device": st["device"],
+               "live_bytes": int(st.get("bytes_in_use", 0)),
+               "peak_bytes": int(st.get("peak_bytes_in_use", 0)),
+               "limit_bytes": (int(st["bytes_limit"])
+                               if st.get("bytes_limit") else None),
+               "live_arrays": int(st.get("num_live_arrays", 0))}
+        out.append(row)
+        if set_gauges:
+            monitor.set_gauge(f"mem.{row['device']}.live_bytes",
+                              row["live_bytes"])
+            monitor.set_gauge(f"mem.{row['device']}.peak_bytes",
+                              row["peak_bytes"])
+    return out
+
+
+def kv_snapshot(manager) -> dict:
+    """Fragmentation view of one KV pool (see
+    `BlockCacheManager.fragmentation`)."""
+    return manager.fragmentation()
+
+
+def memory_report(managers=None, top_n: int = 8) -> dict:
+    """One self-contained memory picture: devices, every KV pool's
+    fragmentation, and the top executables by compiler-reported peak
+    bytes (from the CostBook's `memory_analysis` cards)."""
+    from .costs import cost_book
+
+    if managers is None:
+        managers = kv_managers()
+    kv = []
+    for m in managers:
+        try:
+            kv.append(kv_snapshot(m))
+        except Exception:
+            pass
+    execs = [r for r in cost_book().rows() if r.get("peak_bytes")]
+    execs.sort(key=lambda r: -r["peak_bytes"])
+    return {"devices": device_memory_snapshot(),
+            "kv": kv,
+            "top_executables_by_peak_bytes": execs[:top_n]}
+
+
+def dump_oom(reason: str, manager=None, live_requests=None, extra=None,
+             directory: Optional[str] = None,
+             force: bool = False) -> Optional[str]:
+    """Write the OOM forensics dump
+    ``flight_oom_<reason>_<pid>_<n>.jsonl``: header, memory report
+    (devices + KV map + top executables by peak bytes), the live
+    request set, then the recent timeline ring. Returns the path, or
+    None when rate-limited or the write failed — never raises into the
+    caller (the serving hot path)."""
+    global _last_dump_t
+    now = time.monotonic()
+    with _lock:
+        if not force and _last_dump_t is not None \
+                and now - _last_dump_t < _min_dump_interval_s:
+            return None
+        _last_dump_t = now
+    from . import timeline
+    from ..framework import monitor
+
+    monitor.inc("observability.oom_dumps")
+    try:
+        report = memory_report(
+            managers=[manager] if manager is not None else None)
+    except Exception:
+        report = {}
+    body = [{"memory": report, "live_requests": live_requests,
+             "extra": extra}]
+    # write_flight_file owns filename sanitization
+    return timeline.write_flight_file(
+        f"oom_{reason}", {"reason": f"oom_{reason}"},
+        body + timeline.flight_events()[-256:], directory)

@@ -29,8 +29,9 @@ import time
 from collections import deque
 from typing import List, Optional
 
-__all__ = ["configure", "request_event", "dispatch_span", "events",
-           "flight_events", "dump_flight", "chrome_events", "reset"]
+__all__ = ["configure", "flight_dir", "request_event", "dispatch_span",
+           "events", "flight_events", "dump_flight", "write_flight_file",
+           "chrome_events", "reset"]
 
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=4096)
@@ -45,6 +46,41 @@ def configure(capacity: int = 4096, flight_dir: Optional[str] = None):
             _flight_dir = flight_dir
         if capacity != _ring.maxlen:
             _ring = deque(_ring, maxlen=capacity)
+
+
+def flight_dir() -> str:
+    """THE flight-recorder output directory — every forensics producer
+    (timeline faults, comm-watchdog trips, OOM dumps) writes here so one
+    incident's evidence is never scattered across directories."""
+    with _lock:
+        return _flight_dir
+
+
+def write_flight_file(name: str, header: dict, lines,
+                      directory: Optional[str] = None) -> Optional[str]:
+    """Shared flight-dump writer: sanitize `name`, number the file,
+    write one JSON header line then one JSON line per entry — and never
+    raise into the caller (forensics must not compound the failure).
+    Returns the path, or None when the write failed."""
+    global _dump_count
+    with _lock:
+        _dump_count += 1
+        n = _dump_count
+    directory = directory or flight_dir()
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+    path = os.path.join(directory,
+                        f"flight_{safe}_{os.getpid()}_{n}.jsonl")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps(dict({"flight_recorder": True,
+                                     "wall_time": time.time()},
+                                    **header)) + "\n")
+            for e in lines:
+                f.write(json.dumps(e) + "\n")
+    except Exception:
+        return None
+    return path
 
 
 def reset():
@@ -103,27 +139,13 @@ def dump_flight(reason: str, directory: Optional[str] = None) -> Optional[str]:
     """Write the flight ring to `<dir>/flight_<reason>_<pid>_<n>.jsonl`
     (header line first). Returns the path, or None when there is nothing
     recorded. Never raises into the serving path."""
-    global _dump_count
     with _lock:
         evs = [e.as_dict() for e in _ring]
-        _dump_count += 1
-        n = _dump_count
     if not evs:
         return None
-    directory = directory or _flight_dir
-    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
-    path = os.path.join(directory, f"flight_{safe}_{os.getpid()}_{n}.jsonl")
-    try:
-        os.makedirs(directory, exist_ok=True)
-        with open(path, "w") as f:
-            f.write(json.dumps({"flight_recorder": True, "reason": reason,
-                                "events": len(evs),
-                                "wall_time": time.time()}) + "\n")
-            for e in evs:
-                f.write(json.dumps(e) + "\n")
-    except Exception:
-        return None
-    return path
+    return write_flight_file(reason,
+                             {"reason": reason, "events": len(evs)},
+                             evs, directory)
 
 
 def chrome_events(base: Optional[float] = None) -> List[dict]:

@@ -328,6 +328,13 @@ class Profiler:
         tl_events = _obs.timeline.events() if _obs.enabled() else []
         candidates = [e.start for e in rec.events] if rec else []
         candidates += [e.t0 for e in tl_events]
+        if _obs.enabled():
+            # records AND step-overlap window starts: a window that opens
+            # before the first recorded event must not push the comms
+            # track to negative ts
+            t0 = _obs.comms.earliest_t0()
+            if t0 is not None:
+                candidates.append(t0)
         base = min(candidates, default=0.0)
         events = []
         if rec:
@@ -340,6 +347,10 @@ class Profiler:
                 })
         if tl_events:
             events.extend(_obs.timeline.chrome_events(base))
+        if _obs.enabled():
+            # pid "comms": per-kind collective tracks + step-overlap
+            # windows, on the SAME clock base as host spans/timelines
+            events.extend(_obs.comms.chrome_events(base))
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "deviceTraceDir": self._device_trace_dir}, f)
@@ -383,6 +394,7 @@ class Profiler:
         lines.extend(self._serving_summary_lines())
         lines.extend(self._resilience_summary_lines())
         lines.extend(self._observability_summary_lines())
+        lines.extend(self._mesh_summary_lines())
         return "\n".join(lines)
 
     # Every section builder scrapes through ONE snapshot of the monitor
@@ -521,10 +533,44 @@ class Profiler:
 
     @staticmethod
     def _observability_summary_lines():
-        """Compile/retrace records and the per-executable cost table
-        (observability layer) — empty unless something was recorded."""
+        """Compile/retrace records, the per-executable cost table, and
+        the collective-trace "Comms:" section (observability layer) —
+        empty unless something was recorded."""
         from .. import observability as _obs
 
         lines = list(_obs.compile_trace.summary_lines())
         lines.extend(_obs.costs.summary_lines())
+        lines.extend(_obs.comms.summary_lines())
+        return lines
+
+    @classmethod
+    def _mesh_summary_lines(cls):
+        """Cross-host aggregation stats (`monitor.aggregate_mesh`):
+        host count, straggler attribution, step-wall spread — plus the
+        current global mesh topology. Empty until an aggregation ran."""
+        from ..framework import monitor
+
+        snap = monitor.snapshot("mesh.", include_histograms=False)
+        # trigger on aggregations, not mesh.hosts: init_parallel_env sets
+        # the hosts gauge unconditionally, and this section's contract is
+        # "empty until an aggregation ran"
+        if not snap.get("mesh.aggregations"):
+            return []
+        hosts = snap.get("mesh.hosts", 0)
+        lines = ["", f"Mesh: {hosts} host(s)"]
+        try:
+            from ..distributed.process_mesh import get_mesh
+
+            mesh = get_mesh()
+            if mesh is not None:
+                d = mesh.describe()
+                lines[-1] += (f", topology {d['shape']} "
+                              f"axes={d['dim_names']}")
+        except Exception:
+            pass
+        if "mesh.straggler_host" in snap:
+            lines.append(
+                f"  straggler host {snap['mesh.straggler_host']} "
+                f"(step-wall spread "
+                f"{snap.get('mesh.step_wall_spread_pct', 0)}%)")
         return lines

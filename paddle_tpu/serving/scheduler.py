@@ -478,6 +478,21 @@ class Scheduler:
             req.req_id, name, self._clock() if t0 is None else t0, t1,
             **meta)
 
+    def _live_requests_brief(self):
+        """The running set, compact, for the OOM forensics dump."""
+        return [{"req_id": r.req_id, "seq_id": r.seq_id, "slot": i,
+                 "tokens": len(r.generated),
+                 "kv_blocks": self.engine.manager.seq_blocks(r.seq_id)}
+                for i, r in enumerate(self.slots) if r is not None]
+
+    def _obs_oom(self, reason: str, **extra):
+        """OOM forensics (observability/memory.py): memory + KV map +
+        live request set to `flight_oom_*.jsonl`. Rate-limited inside
+        `dump_oom`; call sites guard on `_obs.enabled()`."""
+        _obs.memory.dump_oom(reason, manager=self.engine.manager,
+                             live_requests=self._live_requests_brief(),
+                             extra=extra or None)
+
     def _record_tpot(self, n_lanes: int, produced: int):
         """Price the last decode/verify dispatch per lane-token: a round
         that committed `produced` tokens across `n_lanes` lanes costs
@@ -553,6 +568,11 @@ class Scheduler:
                                         self._clock(), None,
                                         error=type(exc).__name__)
             _obs.timeline.dump_flight(f"step_fault_{phase}")
+            if "RESOURCE_EXHAUSTED" in repr(exc):
+                # backend allocation failure: the device-side OOM twin of
+                # the KV-pool exhaustion dump
+                self._obs_oom(f"backend_{phase}",
+                              error=type(exc).__name__)
         limit = self._wd.step_retries if self._wd is not None else 3
         if self._step_faults > limit:
             self._step_faults = 0
@@ -945,10 +965,16 @@ class Scheduler:
                 self._finish(req, RequestStatus.FINISHED, "length_cap",
                              slot=slot)
                 return 0
-            except KVCacheExhausted:
+            except KVCacheExhausted as e:
                 if want > 1:
                     want = 1
                     continue
+                if _obs.enabled():
+                    # real pressure (a single-token grow failed): snapshot
+                    # the memory picture BEFORE the preempt/finish below
+                    # mutates the pool it should explain
+                    self._obs_oom("kv_exhausted", need=e.need, free=e.free,
+                                  total=e.total, seq_id=req.seq_id)
                 if not self._preempt_one(exclude=req):
                     self._finish(req, RequestStatus.FINISHED, "kv_capacity",
                                  slot=slot)

@@ -59,6 +59,7 @@ _METRIC_TO_SCENARIO = {
     "llama_train_mfu_1chip": "train_mfu",
     "serving_throughput": "serving_throughput",
     "serving_throughput_spec": "serving_spec",
+    "dryrun_multichip_comms": "dryrun_multichip",
 }
 
 
@@ -79,8 +80,6 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     bl = _load_baseline_mod()
-    gate_pct = (bl.DEFAULT_GATE_PCT if args.gate_pct is None
-                else args.gate_pct)
     try:
         run = _read_run(args.run)
     except (OSError, ValueError) as e:
@@ -88,6 +87,11 @@ def main(argv=None) -> int:
         return 2
     scenario = run.get("scenario") or _METRIC_TO_SCENARIO.get(
         run.get("metric", ""))
+    # per-scenario default tolerance (noisy timing-derived gates carry a
+    # wider one); an explicit --gate-pct always wins — including over
+    # per-metric caps (the operator's escape hatch)
+    gate_pct = (bl.scenario_gate_pct(scenario) if args.gate_pct is None
+                else args.gate_pct)
     if not scenario:
         print("bench_diff: run has neither scenario tag nor known metric",
               file=sys.stderr)
@@ -105,7 +109,8 @@ def main(argv=None) -> int:
             return 0 if saved else 2
         return 2
 
-    result = bl.compare_reports(run, baseline, gate_pct=gate_pct)
+    result = bl.compare_reports(run, baseline, gate_pct=gate_pct,
+                                honor_metric_caps=args.gate_pct is None)
     out = {
         "scenario": scenario,
         "gate_pct": gate_pct,

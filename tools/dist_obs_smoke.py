@@ -1,0 +1,210 @@
+"""Distributed-observability smoke: dryrun + the whole comm/memory/mesh
+layer, end to end, in <20 s on CPU.
+
+Runs `dryrun_multichip(8)` (virtual CPU devices) with observability AND
+the profiler on, plus an explicit eager-collective sweep over the mesh,
+then asserts the layer's artifacts (ISSUE 9 acceptance):
+
+1. the chrome-trace export contains a populated ``comms`` track —
+   per-kind collective events with byte payloads, correlated (same
+   clock base) with the step-overlap windows on the steps thread;
+2. `monitor.snapshot()` carries nonzero per-collective-kind byte/wall
+   counters and the dryrun's comm block carries the HLO collective
+   census of the GSPMD train step + per-path exposure reports;
+3. `monitor.aggregate_mesh()` returns a mesh aggregation snapshot with
+   straggler attribution fields;
+4. a per-device memory snapshot + KV fragmentation snapshot exist;
+5. a gated `dryrun_multichip` baseline write PASSES `tools/bench_diff.py`
+   against itself and a doctored 10 % exposed-comm regression exits 1.
+
+Usage: python tools/dist_obs_smoke.py
+Exit code 0 on success; prints one JSON line with the smoke's evidence.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def dryrun_with_obs(tmp):
+    import __graft_entry__ as ge
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.observability as obs
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.observability import comms, memory
+
+    obs.enable()
+    obs.reset()
+    monitor.reset_prefix("comm.")
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    report = ge.dryrun_multichip(8)
+    # explicit eager sweep: every collective kind leaves a trace record
+    t = Tensor(np.ones((8, 64), np.float32))
+    dist.scatter(t)
+    with comms.step_overlap("smoke_collective_sweep"):
+        dist.all_reduce(t)
+        dist.all_gather(None, t)
+        dist.broadcast(t, src=0)
+        lst = [Tensor(np.full((8,), float(i), np.float32))
+               for i in range(8)]
+        out = Tensor(np.zeros((8, 8), np.float32))
+        dist.reduce_scatter(out, lst)
+        dist.alltoall(None, lst)
+        from paddle_tpu.distributed.communication.collective import \
+            p2p_shift
+
+        p2p_shift(t, 1)
+    prof.stop()
+
+    assert report is not None and report.get("paths"), report
+    assert report["train_step_hlo_collectives"].get(
+        "all_reduce", {}).get("ops", 0) > 0, report
+    # ---- nonzero per-kind byte counters ----
+    snap = monitor.snapshot("comm.", include_histograms=False)
+    kinds = ("all_reduce", "all_gather", "reduce_scatter", "alltoall",
+             "broadcast", "scatter", "ppermute")
+    for k in kinds:
+        assert snap.get(f"comm.{k}.calls", 0) >= 1, (k, snap)
+        assert snap.get(f"comm.{k}.bytes", 0) > 0, (k, snap)
+
+    # ---- chrome export: populated comms track, step-correlated ----
+    trace_path = os.path.join(tmp, "dist_obs_trace.json")
+    prof.export(trace_path)
+    ev = [e for e in json.load(open(trace_path))["traceEvents"]
+          if e.get("pid") == "comms" and e.get("ph") != "M"]
+    colls = [e for e in ev if e["cat"] == "comm"]
+    steps = [e for e in ev if e["cat"] == "step"]
+    assert colls, "comms track has no collective events"
+    assert {e["name"] for e in colls} >= set(kinds), \
+        {e["name"] for e in colls}
+    assert all(e["args"]["bytes"] >= 0 and e["ts"] >= 0 for e in colls)
+    sweep = next(e for e in steps if e["name"] == "smoke_collective_sweep")
+    inside = [e for e in colls
+              if sweep["ts"] <= e["ts"] <= sweep["ts"] + sweep["dur"]]
+    assert len(inside) >= 6, \
+        f"sweep window should contain the sweep collectives: {len(inside)}"
+
+    # ---- mesh aggregation snapshot ----
+    agg = monitor.aggregate_mesh()
+    assert agg["hosts"] >= 1 and "straggler_host" in agg
+    assert len(agg["per_host_step_wall_ms"]) == agg["hosts"]
+
+    # ---- memory + KV fragmentation ----
+    devices = memory.device_memory_snapshot()
+    assert devices and all(d["live_bytes"] >= 0 for d in devices)
+    from paddle_tpu.inference.cache import BlockCacheManager
+
+    mgr = BlockCacheManager(num_blocks=16, block_size=4,
+                            max_blocks_per_seq=8)
+    mgr.allocate(-1, 1)
+    mgr.allocate(1, 9)
+    frag = mgr.fragmentation()
+    assert frag["guard_blocks"] == 1 and frag["per_seq"][1]["tokens"] == 9
+    assert "Comms:" in prof.summary() and "Mesh:" in prof.summary()
+
+    obs.disable()
+    return {
+        "paths": sorted(report["paths"]),
+        "exposed_ms_total": report["exposed_ms_total"],
+        "algbw_gbs": report["algbw_gbs"],
+        "hlo_all_reduce_ops":
+            report["train_step_hlo_collectives"]["all_reduce"]["ops"],
+        "comm_kinds_traced": sorted({e["name"] for e in colls}),
+        "mesh_hosts": agg["hosts"],
+        "devices": len(devices),
+    }
+
+
+def bench_gate(tmp):
+    """Self-baseline passes; doctored regressions fail (exit 1) under
+    the dryrun_multichip GATED_METRICS: exposure/bandwidth carry the
+    wide timing gate (30 %), the deterministic HLO comm volume keeps
+    the tight 5 % cap."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bl", os.path.join(_REPO, "paddle_tpu", "observability",
+                            "baseline.py"))
+    bl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bl)
+    assert "dryrun_multichip" in bl.GATED_METRICS
+    assert bl.scenario_gate_pct("dryrun_multichip") > bl.DEFAULT_GATE_PCT
+    bdir = os.path.join(tmp, "baselines")
+    report = {"scenario": "dryrun_multichip", "platform": "cpu",
+              "metric": "dryrun_multichip_comms", "value": 5.0,
+              "extras": {"exposed_ms_per_step": 5.0, "algbw_gbs": 2.0,
+                         "train_step_hlo_collectives": {
+                             "all_reduce": {"ops": 64, "bytes": 200000}}}}
+    saved, reason = bl.BaselineStore(bdir).update(report)
+    assert saved, reason
+
+    def run_diff(rep, argv=(), **extras):
+        p = os.path.join(tmp, "run.json")
+        doc = dict(rep)
+        if extras:
+            doc["extras"] = dict(rep["extras"], **extras)
+        json.dump(doc, open(p, "w"))
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "bench_diff.py"),
+             p, "--baseline-dir", bdir, *argv],
+            capture_output=True, text=True)
+        return r.returncode
+
+    rc_self = run_diff(report)
+    assert rc_self == 0, f"self-baseline must pass, got rc={rc_self}"
+    # +10% exposure: inside the wide timing gate — run-to-run noise of a
+    # sub-ms wall must NOT fail CI
+    assert run_diff(report, exposed_ms_per_step=5.5) == 0
+    rc_bad = run_diff(report, exposed_ms_per_step=7.0)      # +40%
+    assert rc_bad == 1, f"40% exposed-comm growth must exit 1, rc={rc_bad}"
+    rc_slow = run_diff(report, algbw_gbs=1.2)               # -40%
+    assert rc_slow == 1, f"40% algbw collapse must exit 1, rc={rc_slow}"
+    # the deterministic volume metric keeps the tight gate: +10% bytes
+    # fails even though the scenario-wide tolerance is 30%
+    rc_vol = run_diff(report, train_step_hlo_collectives={
+        "all_reduce": {"ops": 64, "bytes": 220000}})
+    assert rc_vol == 1, f"10% comm-volume growth must exit 1, rc={rc_vol}"
+    # ... and an operator's EXPLICIT --gate-pct overrides the cap (the
+    # CLI escape hatch after an intentional sharding change)
+    rc_escape = run_diff(report, argv=("--gate-pct", "50"),
+                         train_step_hlo_collectives={
+                             "all_reduce": {"ops": 64, "bytes": 220000}})
+    assert rc_escape == 0, f"--gate-pct 50 must override the cap, " \
+                           f"rc={rc_escape}"
+    return {"self_rc": rc_self, "doctored_exposed_rc": rc_bad,
+            "doctored_algbw_rc": rc_slow, "doctored_volume_rc": rc_vol,
+            "gate_pct_escape_rc": rc_escape}
+
+
+def main():
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        out = dryrun_with_obs(tmp)
+        out.update(bench_gate(tmp))
+    out["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

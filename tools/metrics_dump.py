@@ -8,6 +8,11 @@ way a Prometheus scraper would see it.
 Usage:
     python tools/metrics_dump.py [--format prom|json] [--prefix serving.]
                                  [--exec "python -c ..."-style snippet]
+                                 [--mesh]
+
+``--mesh`` prints the coordinator-side cross-host aggregation
+(`monitor.aggregate_mesh`: summed counters, per-host step walls,
+straggler attribution) as JSON instead of the local registry.
 
 Examples:
     # render whatever a short serving run left in the registry
@@ -35,6 +40,9 @@ def main(argv=None) -> int:
     ap.add_argument("--exec", dest="snippet", default=None,
                     help="python snippet run before dumping (to populate "
                          "the registry in-process)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="print the cross-host aggregation "
+                         "(aggregate_mesh) as JSON")
     args = ap.parse_args(argv)
 
     from paddle_tpu.framework import monitor
@@ -42,6 +50,10 @@ def main(argv=None) -> int:
     if args.snippet:
         exec(compile(args.snippet, "<metrics_dump --exec>", "exec"), {})
 
+    if args.mesh:
+        print(json.dumps(monitor.aggregate_mesh(args.prefix), indent=1,
+                         sort_keys=True))
+        return 0
     if args.format == "json":
         print(json.dumps(monitor.snapshot(args.prefix), indent=1,
                          sort_keys=True))
