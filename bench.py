@@ -633,10 +633,14 @@ def serving_throughput_main():
     try:
         from paddle_tpu.observability import costs as _costs
 
+        # the serving decode program is the ragged step: lower it at the
+        # scheduler's packed shapes (T = lanes + chunk budget)
         fn, leading = engine.cost_card_args("decode")
         B = engine.max_batch_size
+        T = fe.scheduler.ragged_tokens
         card = _costs.card_from_lowered(
-            fn, *leading, np.zeros((B,), np.int32), np.ones((B,), np.int32),
+            fn, *leading, np.zeros((T,), np.int32),
+            np.ones((B,), np.int32), np.ones((B,), np.int32),
             np.zeros((B, engine.manager.max_blocks_per_seq), np.int32))
         if card.flops:
             dsteps = max(extras["decode_steps"], 1)
@@ -689,10 +693,15 @@ def _overload_bench(build_engine, capacity_tok_s, mean_gen_tokens):
     # queue IS the degradation; shed instead. Throughput-leaning
     # deployments raise the watermark and trade TTFT for goodput
     # (docs/SERVING.md "watermark tuning").
+    # chunk budget sized to the burst's whole per-step admission load
+    # (8 slots x <=20-token prompts): this is a latency-isolation bench,
+    # so TTFT must not queue behind the chunk budget — the TPOT side of
+    # that trade-off has its own scenario (serving_mixed)
     fe = ServingFrontend(
         build_engine(),
         admission=AdmissionConfig(queue_high=1, queue_low=0,
-                                  kv_high=0.95, kv_low=0.8))
+                                  kv_high=0.95, kv_low=0.8),
+        prefill_chunk_tokens=160)
     rng = np.random.default_rng(7)
     # compile coverage before any timing. One request at a time: this
     # frontend sheds on queue depth, so submitting the four bucket
@@ -924,6 +933,264 @@ def serving_spec_main():
         "vs_baseline": round(speedup / 1.3, 2),  # >=1.3x is the bar
         "extras": extras,
     }, "serving_spec")
+
+
+@scenario("serving_mixed", 420)
+def serving_mixed_main():
+    """`python bench.py serving_mixed` — the chunked-prefill acceptance
+    instrument (ISSUE 10): decode traffic keeps flowing while a 4k+-token
+    prompt arrives mid-stream. Decode TPOT p99 during the long prompt's
+    prefill must stay < 1.5x the no-prefill steady state (per-step wall
+    over live decode lanes == per-token latency: every live lane commits
+    exactly one token per ragged round); a monolithic-prefill baseline
+    (chunk budget >= the whole prompt, i.e. the pre-ISSUE-10 dispatch
+    shape) runs the same trace for contrast and shows the stall. Also
+    asserted in-run: zero ragged retraces across the measured phases —
+    the steady state holds ONE prompt-length-independent executable."""
+    probe = _scenario_setup("serving_mixed")
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.inference import LlamaInferenceEngine
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import (RequestStatus, ServingFrontend,
+                                    ServingMetrics)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    long_len = int(os.environ.get("BENCH_MIXED_PROMPT", "4096"))
+    chunk = int(os.environ.get("BENCH_MIXED_CHUNK", "64"))
+    model = llama_tiny(vocab=128, layers=2, hidden=64, heads=4,
+                      seq=long_len + 512)
+    model.eval()
+
+    def build_engine():
+        return LlamaInferenceEngine(
+            model, max_batch_size=8, block_size=8,
+            num_blocks=long_len // 8 + 192,
+            max_blocks_per_seq=long_len // 8 + 32,
+            **({"dtype": "bfloat16"} if on_tpu else {}))
+
+    rng = np.random.default_rng(0)
+
+    def run_phases(chunk_tokens):
+        """One engine, three phases: warmup -> steady decode window ->
+        the same decode lanes with the long prompt prefilling. Returns
+        per-step wall samples for both windows + decode token counts."""
+        ServingMetrics.reset_monitor()
+        fe = ServingFrontend(build_engine(),
+                             prefill_chunk_tokens=chunk_tokens)
+        # warmup: compile the ragged step + drain
+        for n in (3, 17):
+            fe.submit(rng.integers(1, 128, n).tolist(), max_new_tokens=2)
+        fe.run_until_idle(max_steps=500)
+        monitor.reset("serving.ragged_retraces")
+        # six long-lived decode lanes
+        lanes = [fe.submit(rng.integers(1, 128, 12).tolist(),
+                           max_new_tokens=10 ** 6) for _ in range(6)]
+        for _ in range(4):
+            fe.step()                       # prompts in, lanes decoding
+        steady = []
+        for _ in range(60):
+            t0 = time.perf_counter()
+            fe.step()
+            steady.append(time.perf_counter() - t0)
+        tok_mark = monitor.get("serving.tokens_generated")
+        long_req = fe.submit(rng.integers(1, 128, long_len).tolist(),
+                             max_new_tokens=4)
+        during = []
+        t_mix = time.perf_counter()
+        while long_req._req.prefilling or not long_req._req._prefill_ctx.size:
+            t0 = time.perf_counter()
+            fe.step()
+            during.append(time.perf_counter() - t0)
+            if len(during) > 4 * (long_len // chunk_tokens + 8):
+                raise RuntimeError("long prompt prefill never completed")
+        mix_wall = time.perf_counter() - t_mix
+        mixed_tokens = monitor.get("serving.tokens_generated") - tok_mark
+        retraces = monitor.get("serving.ragged_retraces")
+        for h in lanes:
+            fe.cancel(h)
+        fe.run_until_idle(max_steps=2000)
+        assert long_req.status is RequestStatus.FINISHED, long_req
+        return steady, during, mixed_tokens, mix_wall, retraces
+
+    p99 = lambda xs: float(np.percentile(np.asarray(xs), 99))  # noqa: E731
+
+    steady, during, mixed_tokens, mix_wall, retraces = run_phases(chunk)
+    chunked = {
+        "steady_tpot_p99_ms": round(p99(steady) * 1e3, 3),
+        "prefill_tpot_p99_ms": round(p99(during) * 1e3, 3),
+        "prefill_steps": len(during),
+        "decode_tok_s_during_prefill": round(mixed_tokens / mix_wall, 1),
+        "ragged_retraces": retraces,
+    }
+    chunked["tpot_degradation_x"] = round(
+        chunked["prefill_tpot_p99_ms"] / chunked["steady_tpot_p99_ms"], 3)
+    # monolithic contrast: the PRE-ISSUE-10 architecture — per-request
+    # full-prompt prefill as its own dispatch, decode lanes blocked for
+    # its whole wall. Driven on raw engine calls (the old scheduler's
+    # shapes): steady [B] decode steps, then ONE [1, long_len] prefill.
+    eng = build_engine()
+    mgr = eng.manager
+    sids = list(range(6))
+    for sid in sids:
+        mgr.allocate(sid, 12)
+    maxb = mgr.max_blocks_per_seq
+    tb = np.zeros((8, maxb), np.int32)
+    tb[:6] = mgr.block_table_array(sids)
+    pad = np.zeros((8, 12), np.int32)
+    pad[:6] = rng.integers(1, 128, (6, 12))
+    logits = eng.prefill(pad, tb, np.full((8,), 12, np.int32))
+    toks = np.argmax(np.asarray(logits), -1).astype(np.int32)
+    for sid in sids:
+        mgr.append_token(sid)
+    lens = np.full((8,), 1, np.int32)
+    lens[:6] = [mgr.seq_len(s) for s in sids]
+    import jax as _jax
+
+    _jax.block_until_ready(eng.decode_step(toks, lens, tb))  # warm
+    m_steady = []
+    for _ in range(40):
+        t0 = time.perf_counter()
+        _jax.block_until_ready(eng.decode_step(toks, lens, tb))
+        m_steady.append(time.perf_counter() - t0)
+    mgr.allocate(7, long_len)
+    tb1 = mgr.block_table_array([7])
+    long_ids = rng.integers(1, 128, (1, long_len)).astype(np.int32)
+    # warm once: the measured stall is the steady-state dispatch, not
+    # the compile (the old bucket family compiled once per bucket too)
+    _jax.block_until_ready(eng.prefill(long_ids, tb1,
+                                       np.asarray([long_len], np.int32)))
+    t0 = time.perf_counter()
+    _jax.block_until_ready(eng.prefill(long_ids, tb1,
+                                       np.asarray([long_len], np.int32)))
+    mono_prefill_s = time.perf_counter() - t0
+    mono = {
+        "steady_tpot_p99_ms": round(p99(m_steady) * 1e3, 3),
+        "stall_step_ms": round(mono_prefill_s * 1e3, 3),
+    }
+    mono["tpot_degradation_x"] = round(
+        mono_prefill_s / p99(m_steady), 3)
+
+    # hard in-run checks: the acceptance contract
+    assert chunked["tpot_degradation_x"] < 1.5, \
+        f"chunked prefill stalls decode: {chunked['tpot_degradation_x']}x"
+    assert retraces == 0, \
+        f"ragged step retraced {retraces}x mid-serving (prompt-length " \
+        f"shaped executables are back)"
+    assert mono["tpot_degradation_x"] > chunked["tpot_degradation_x"], \
+        "monolithic baseline shows no stall: the contrast is meaningless"
+    extras = {
+        "long_prompt_tokens": long_len,
+        "prefill_chunk_tokens": chunk,
+        "chunked": chunked,
+        "monolithic": mono,
+        "tpot_p99_during_prefill_ms": chunked["prefill_tpot_p99_ms"],
+        "tpot_degradation_x": chunked["tpot_degradation_x"],
+        "probe": probe,
+        "device": jax.devices()[0].device_kind or "cpu",
+    }
+    _emit_report({
+        "metric": "serving_mixed_decode_tok_s",
+        "value": chunked["decode_tok_s_during_prefill"],
+        "unit": f"decode tok/s while a {long_len}-token prompt prefills "
+                f"(TPOT p99 {chunked['prefill_tpot_p99_ms']} ms = "
+                f"{chunked['tpot_degradation_x']}x steady; monolithic "
+                f"stall {mono['stall_step_ms']} ms)",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "serving_mixed")
+
+
+@scenario("kernel_micro", 300)
+def kernel_micro_main():
+    """`python bench.py kernel_micro` — paged-attention kernel microbench
+    (ROADMAP item 5's missing kernel scenario): ragged vs legacy
+    decode/verify dispatch wall time across batch compositions. On TPU
+    this times the Pallas kernels; on CPU the XLA reference paths (the
+    production fallback), platform-tagged like every other scenario."""
+    probe = _scenario_setup("kernel_micro")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    rng = np.random.default_rng(0)
+    NB, KVH, BS, D, H = 128, 2, 16, 64, 8
+    B, MAXB = 8, 8
+    kc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(NB, KVH, BS, D)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(NB - 1)[:B * MAXB].reshape(
+        B, MAXB) + 1, jnp.int32)
+
+    decode_fn = pa.paged_attention if on_tpu else pa.paged_attention_ref
+    verify_fn = (pa.paged_attention_verify if on_tpu
+                 else pa.paged_attention_verify_ref)
+    ragged_fn = (pa.paged_attention_ragged if on_tpu
+                 else pa.paged_attention_ragged_ref)
+
+    def timed(fn, *args, reps=50):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1e6   # us/dispatch
+
+    def ragged_args(q_lens, kv_lens, t):
+        lane, pos = pa.ragged_metadata(jnp.asarray(q_lens, jnp.int32),
+                                       jnp.asarray(kv_lens, jnp.int32), t)
+        q = jnp.asarray(rng.normal(size=(t, H, D)), jnp.float32)
+        return q, kc, vc, tables, jnp.asarray(kv_lens, jnp.int32), lane, pos
+
+    out = {}
+    # composition 1: pure decode, 8 lanes
+    kv = [97, 64, 33, 120, 8, 77, 50, 101]
+    q1 = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    out["decode_legacy_us"] = timed(decode_fn, q1, kc, vc, tables,
+                                    jnp.asarray(kv, jnp.int32))
+    out["decode_ragged_us"] = timed(ragged_fn, *ragged_args([1] * B, kv, B))
+    # composition 2: mixed — 7 decode lanes + one 32-token chunk (the
+    # serving hot shape; no legacy equivalent in ONE dispatch)
+    mixed_q = [1] * 7 + [32]
+    mixed_kv = kv[:7] + [96]
+    t_mixed = 7 + 32
+    out["mixed_ragged_us"] = timed(
+        ragged_fn, *ragged_args(mixed_q, mixed_kv, t_mixed))
+    out["mixed_ragged_tok_s"] = round(t_mixed / out["mixed_ragged_us"]
+                                      * 1e6)
+    # composition 3: verify window, 8 lanes x 5 tokens
+    S = 5
+    qv = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    kv_v = [k + S for k in kv]
+    out["verify_legacy_us"] = timed(verify_fn, qv, kc, vc, tables,
+                                    jnp.asarray(kv_v, jnp.int32))
+    out["verify_ragged_us"] = timed(
+        ragged_fn, *ragged_args([S] * B, kv_v, B * S))
+    for k in out:
+        if k.endswith("_us"):
+            out[k] = round(out[k], 1)
+    out["decode_ragged_vs_legacy_x"] = round(
+        out["decode_legacy_us"] / out["decode_ragged_us"], 3)
+    out["verify_ragged_vs_legacy_x"] = round(
+        out["verify_legacy_us"] / out["verify_ragged_us"], 3)
+    extras = dict(out, probe=probe, shapes={
+        "blocks": NB, "block_size": BS, "kv_heads": KVH, "heads": H,
+        "head_dim": D, "lanes": B, "impl": "pallas" if on_tpu else
+        "xla_ref"})
+    _emit_report({
+        "metric": "kernel_micro_paged_attention",
+        "value": out["mixed_ragged_tok_s"],
+        "unit": f"ragged tok/s on the mixed 7-decode+32-chunk dispatch "
+                f"(decode ragged/legacy {out['decode_ragged_vs_legacy_x']}"
+                f"x, verify {out['verify_ragged_vs_legacy_x']}x)",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "kernel_micro")
 
 
 @scenario("dryrun_multichip", 300)

@@ -27,7 +27,8 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_current_worker_info", "WorkerInfo"]
 
 _state: Dict[str, Any] = {"inited": False, "workers": {}, "me": None,
-                          "responder": None, "stop": False}
+                          "responder": None, "stop": False,
+                          "next_slot": {}}
 
 
 def _client():
@@ -80,11 +81,37 @@ def _resp_key(rank: int, slot: int) -> str:
 
 
 def _claim_slot(rank: int) -> int:
-    """Atomically claim the next request slot on `rank`'s inbox: the
-    coordination service's key_value_increment gives a total order even
-    with many concurrent callers (no per-caller counters to collide)."""
-    return int(_client().key_value_increment(
-        f"ptpu_rpc/inbox/{rank}", 1)) - 1
+    """Atomically claim the next request slot on `rank`'s inbox, giving
+    a total order even with many concurrent callers (no per-caller
+    counters to collide). Preferred: the coordination service's atomic
+    counter. jaxlib builds WITHOUT `key_value_increment` (it comes and
+    goes across releases) fall back to first-writer-wins claims:
+    `key_value_set(allow_overwrite=False)` rejects duplicate keys, so
+    exactly one caller wins each slot and losers probe the next one —
+    same total order, a few extra KV round-trips only under contention."""
+    c = _client()
+    if hasattr(c, "key_value_increment"):
+        return int(c.key_value_increment(f"ptpu_rpc/inbox/{rank}", 1)) - 1
+    slot = _state["next_slot"].get(rank, 0)
+    while True:
+        try:
+            c.key_value_set(f"ptpu_rpc/claim/{rank}/{slot}",
+                            str(_state["me"].rank), allow_overwrite=False)
+        except Exception as e:
+            # ONLY a lost race moves to the next slot. Any other
+            # coordination-service error must surface: treating it as
+            # ALREADY_EXISTS would skip a slot nobody claimed, and the
+            # responder (which serves slots strictly in order) would
+            # block on the hole forever.
+            msg = str(e).lower()
+            # bare "exist" would also match "does not exist" errors from
+            # a disconnected service and spin the claim loop forever
+            if "already exist" in msg or "duplicate" in msg:
+                slot += 1
+                continue
+            raise
+        _state["next_slot"][rank] = slot + 1
+        return slot
 
 
 def _serve_loop():

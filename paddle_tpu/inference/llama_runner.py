@@ -173,14 +173,19 @@ class LlamaInferenceEngine:
             _decode_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
         self._verify = jax.jit(functools.partial(
             _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
+        self._ragged = jax.jit(functools.partial(
+            _ragged_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
 
     def cost_card_args(self, phase: str):
         """Observability hook (`observability.costs.ensure_engine_card`):
         the jitted executable behind `phase` plus the leading arguments
         the scheduler never sees (stacked params + paged KV). Lowered —
         never executed — for `cost_analysis()`: compiler-reported FLOPs
-        per prefill/decode/verify dispatch."""
-        fn = {"prefill": self._prefill, "decode": self._decode,
+        per dispatch. The serving scheduler's "decode" phase is the
+        ragged step (its only decode program); the legacy single-token
+        executable stays reachable as "decode_legacy" for microbenches."""
+        fn = {"prefill": self._prefill, "decode": self._ragged,
+              "ragged": self._ragged, "decode_legacy": self._decode,
               "verify": self._verify}[phase]
         return fn, (self.params, self.k_cache, self.v_cache)
 
@@ -219,6 +224,32 @@ class LlamaInferenceEngine:
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32))
+        return logits
+
+    def ragged_step(self, tokens: np.ndarray, q_lens: np.ndarray,
+                    kv_lens: np.ndarray, block_tables: np.ndarray):
+        """ONE fixed-shape step over a packed ragged batch — the serving
+        scheduler's only decode-path program (chunked prefill + decode
+        lanes fused; see docs/SERVING.md "Ragged batching").
+
+        tokens [T] int32: packed lane-major query tokens; lane i owns
+        slots [sum(q_lens[:i]), sum(q_lens[:i]) + q_lens[i]), its token j
+        landing at position `kv_lens[i] - q_lens[i] + j` (kv_lens counts
+        the cache INCLUDING this step's tokens; q_lens[i] == 0 marks an
+        empty lane). Returns logits [T, V]; rows at guard slots past
+        sum(q_lens) are meaningless and must be ignored (their KV writes
+        are dropped, their attention output is forced to zero).
+        Shape-stable in everything but T, which the scheduler fixes at
+        `max_batch_size + prefill_chunk_tokens` — one compiled
+        executable regardless of batch composition or prompt length."""
+        import jax.numpy as jnp
+
+        logits, self.k_cache, self.v_cache = self._ragged(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(q_lens, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32),
             jnp.asarray(block_tables, jnp.int32))
         return logits
 
@@ -324,12 +355,17 @@ class _StaticCfg:
         return self.__dict__ == o.__dict__
 
 
-def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode):
+def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
+                ragged_meta=None):
     """One decoder layer on [B, S, H]; returns (x, (new_k_blocks, new_v_blocks)).
 
     `mode`: "prefill" (dense causal SDPA over the in-flight tokens),
-    "decode" (single-query paged attention), or "verify" (S-query causal
-    paged attention — the speculative multi-token verify pass)."""
+    "decode" (single-query paged attention), "verify" (S-query causal
+    paged attention — the speculative multi-token verify pass), or
+    "ragged" (packed mixed prefill-chunk/decode/verify tokens: x is
+    [1, T, H], `ragged_meta` = (tok_lane, tok_pos) maps every packed
+    token to its lane and absolute position, ctx_lens is per-lane
+    kv_lens — ONE fixed-shape program for every batch composition)."""
     import jax
     import jax.numpy as jnp
 
@@ -349,6 +385,26 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode):
     si = jnp.take(sin, positions, axis=0)[:, :, None, :]
     q = _rope_half(q, c, si)
     k = _rope_half(k, c, si)
+
+    if mode == "ragged":
+        tok_lane, tok_pos = ragged_meta
+        kc, vc = pk.write_kv_to_cache_ragged(
+            k[0], v[0], kc, vc, tables, tok_lane, tok_pos)
+        qr = q[0]                                     # [T, NH, D]
+        if pk.ragged_supported((s, nh, d), qr.dtype):
+            attn = pk.paged_attention_ragged(
+                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos)
+        else:
+            attn = pk.paged_attention_ragged_ref(
+                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos)
+        attn = attn.reshape(1, s, nh * d)
+        x = x + _mm(attn, o_w)
+        h2 = _rms(x, ln2, cfg.eps)
+        gu = _mm(h2, gu_w)
+        g, u = jnp.split(gu, 2, axis=-1)
+        act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
+        x = x + _mm(act, down_w)
+        return x, (kc, vc)
 
     start = positions[:, 0].astype(jnp.int32)
     kc, vc = pk.write_kv_to_cache(k, v, kc, vc, tables, start)
@@ -386,7 +442,7 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode):
 
 
 def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
-               cfg, mode):
+               cfg, mode, ragged_meta=None):
     import jax
     import jax.numpy as jnp
 
@@ -397,7 +453,7 @@ def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
         x, (kc, vc) = _layer_body(
             x, (ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc, cos, sin),
             cfg=cfg, positions=positions, tables=tables, ctx_lens=ctx_lens,
-            mode=mode)
+            mode=mode, ragged_meta=ragged_meta)
         return x, (kc, vc)
 
     xs = (params["ln1"], params["qkv_w"], params["o_w"], params["ln2"],
@@ -448,18 +504,53 @@ def _decode_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
     return logits[:, -1, :].astype(jnp.float32), nk, nv
 
 
+def _ragged_stack(params, k_cache, v_cache, tokens, q_lens, kv_lens,
+                  tables, cfg):
+    """Shared body of the ragged and verify entry points: packed tokens
+    [T] + per-lane (q_len, kv_len) metadata through the decoder stack in
+    ragged mode. Returns (logits [T, V], new_k, new_v)."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas import paged_attention as pk
+
+    t = tokens.shape[0]
+    tok_lane, tok_pos = pk.ragged_metadata(q_lens, kv_lens, t)
+    x = jnp.take(params["embed"], tokens[None, :], axis=0)   # [1, T, H]
+    positions = jnp.maximum(tok_pos, 0)[None, :]             # [1, T]
+    logits, nk, nv = _run_stack(
+        params, k_cache, v_cache, x, positions, tables,
+        kv_lens.astype(jnp.int32), cfg, mode="ragged",
+        ragged_meta=(tok_lane, tok_pos))
+    return logits[0].astype(jnp.float32), nk, nv             # [T, V]
+
+
+def _ragged_fn(params, k_cache, v_cache, tokens, q_lens, kv_lens, tables,
+               *, cfg):
+    from ..framework import monitor
+
+    # Trace-time side effects (see prefill): the ragged step IS the
+    # serving decode program, so it owns the decode_retraces counter the
+    # zero-recompile suite asserts on; ragged_retraces additionally pins
+    # "ONE executable regardless of batch composition / prompt length".
+    monitor.inc("serving.decode_retraces")
+    monitor.inc("serving.ragged_retraces")
+    return _ragged_stack(params, k_cache, v_cache, tokens, q_lens,
+                         kv_lens, tables, cfg)
+
+
 def _verify_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
+    """Speculative verify as a special case of the ragged step: every
+    lane contributes a fixed q_len == S window, so the packed buffer is
+    just tokens.reshape(B*S) and the logits fold back to [B, S, V]."""
     import jax.numpy as jnp
 
     from ..framework import monitor
 
     monitor.inc("serving.verify_retraces")  # trace-time only (see prefill)
     b, s = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)            # [B, S, H]
-    positions = jnp.maximum(
-        ctx_lens[:, None] - s + jnp.arange(s, dtype=jnp.int32)[None, :],
-        0).astype(jnp.int32)                                 # [B, S]
-    logits, nk, nv = _run_stack(params, k_cache, v_cache, x, positions,
-                                tables, ctx_lens.astype(jnp.int32), cfg,
-                                mode="verify")
-    return logits.astype(jnp.float32), nk, nv                # [B, S, V]
+    q_lens = jnp.full((b,), s, jnp.int32)
+    logits, nk, nv = _ragged_stack(params, k_cache, v_cache,
+                                   tokens.reshape(b * s),
+                                   q_lens, ctx_lens.astype(jnp.int32),
+                                   tables, cfg)
+    return logits.reshape(b, s, -1), nk, nv                  # [B, S, V]

@@ -38,6 +38,12 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
     "serving_throughput": [("value", "higher"),
                            ("extras.ttft_p99_ms", "lower")],
     "serving_spec": [("value", "higher")],
+    # chunked-prefill acceptance (ISSUE 10): decode throughput while a
+    # long prompt prefills must not drop, and decode TPOT p99 during the
+    # prefill window must not grow
+    "serving_mixed": [("value", "higher"),
+                      ("extras.tpot_p99_during_prefill_ms", "lower")],
+    "kernel_micro": [("value", "higher")],
     # distributed observability dryrun: host-exposed comm must not grow,
     # traced bandwidth must not collapse, and the GSPMD step's comm
     # VOLUME (deterministic — from the compiled HLO, so it keeps the

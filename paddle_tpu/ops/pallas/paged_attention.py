@@ -275,6 +275,225 @@ def paged_attention_verify(q, k_cache, v_cache, block_tables, context_lens,
     return jnp.swapaxes(out, 1, 2).reshape(batch, s, h, d)
 
 
+def _ragged_kernel(kv_lens_ref, tables_ref, lane_ref, pos_ref,
+                   q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                   sm_scale, block_size):
+    """Ragged paged attention: ONE fixed-shape kernel for mixed
+    prefill-chunk + decode + verify batches.
+
+    The grid iterates fixed-shape token tiles over a PACKED query buffer:
+    tile t is one query token's head-group band [g_pad, D] (so a decode
+    lane costs exactly one tile and a 32-token prefill chunk costs 32 —
+    zero bucket padding). Per-token scalar-prefetch metadata maps every
+    tile to its owning sequence lane (`lane_ref`) and absolute position
+    (`pos_ref`, -1 for guard/empty token slots); the per-lane
+    `(kv_len, q_len, q_start)` prefix sums are folded into those two
+    arrays on the host/XLA side. Causal masking per tile is
+    `kv_pos <= pos_ref[t]`; guard tiles (pos -1, or a lane with
+    kv_len == 0) compute nothing and emit zeros via the l_safe finish.
+    Same online-softmax structure as `_decode_kernel` — the decode and
+    verify kernels are special cases of this one (q_len==1 / q_len==S).
+    """
+    t = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    lane = lane_ref[t]
+    ctx_len = kv_lens_ref[lane]
+    qpos = pos_ref[t]
+
+    @pl.when((j * block_size < ctx_len) & (qpos >= 0))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
+        k = k_ref[0, 0].astype(jnp.float32)                 # (BS, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # typed scalar: see the NEG_INF note in _decode_kernel
+        s = jnp.where(pos <= qpos, s, jnp.float32(NEG_INF))
+        m_prev = m_ref[...][:, 0]
+        l_prev = l_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _ragged_call(q, k_cache, v_cache, block_tables, kv_lens, tok_lane,
+                 tok_pos, sm_scale):
+    """q: [T, KV_H, Gp, D] packed tokens; caches: [KV_H, NB, BS, D]."""
+    tokens, kv_h, g_pad, d = q.shape
+    block_size = k_cache.shape[2]
+    max_blocks = block_tables.shape[1]
+
+    kern = functools.partial(_ragged_kernel, sm_scale=sm_scale,
+                             block_size=block_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(tokens, kv_h, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g_pad, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (t, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g_pad, d),
+                               lambda t, h, j, lens, tables, lane, pos:
+                               (t, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, d), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+        ],
+    )
+    return _support.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, kv_h, g_pad, d), q.dtype),
+        interpret=_support.interpret_mode(),
+    )(kv_lens, block_tables, tok_lane, tok_pos, q, k_cache, v_cache)
+
+
+def ragged_metadata(q_lens, kv_lens, num_tokens):
+    """Per-token `(lane, position)` metadata for the packed query buffer.
+
+    q_lens/kv_lens: [B] int32 per-lane token counts (q_len 0 = empty
+    lane). Returns (tok_lane [T], tok_pos [T]) int32 where lane i owns
+    the packed slots [sum(q_lens[:i]), sum(q_lens[:i+1])) and its token
+    j sits at absolute position kv_len - q_len + j; guard slots past
+    sum(q_lens) get pos -1 (and lane clamped into range), which gates
+    every kernel/ref compute off. Pure jnp — callable inside jit."""
+    q_lens = q_lens.astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+    ends = jnp.cumsum(q_lens)                                 # [B]
+    t_idx = jnp.arange(num_tokens, dtype=jnp.int32)           # [T]
+    lane = jnp.searchsorted(ends, t_idx, side="right").astype(jnp.int32)
+    valid = t_idx < ends[-1]
+    lane = jnp.minimum(lane, q_lens.shape[0] - 1)
+    off = t_idx - (ends[lane] - q_lens[lane])
+    pos = kv_lens[lane] - q_lens[lane] + off
+    return lane, jnp.where(valid, pos, jnp.int32(-1))
+
+
+def paged_attention_ragged(q, k_cache, v_cache, block_tables, kv_lens,
+                           tok_lane, tok_pos, sm_scale=None):
+    """Ragged paged attention over a packed query token buffer.
+
+    ONE kernel for every serving batch composition: decode lanes
+    (q_len 1), prefill chunks (q_len n), and speculative verify windows
+    (q_len K+1) share this fixed-shape dispatch — the grid depends only
+    on the packed token budget T, never on the batch composition, so the
+    serving steady state holds exactly one compiled executable.
+
+    Args:
+      q: [T, H, D] — packed query tokens (lane-major, see
+         `ragged_metadata`).
+      k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim].
+      block_tables: [B, W] int32 physical block ids per lane.
+      kv_lens: [B] int32 — tokens in cache per lane INCLUDING this
+         dispatch's own tokens (0 for empty lanes).
+      tok_lane/tok_pos: [T] int32 per-token owner lane / absolute
+         position (-1 = guard slot, output forced to 0).
+    Returns [T, H, D]; guard rows are exact zeros.
+    """
+    tokens, h, d = q.shape
+    kv_h = k_cache.shape[1]
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    g_pad = g if g % 8 == 0 else (g // 8 + 1) * 8
+    qg = q.reshape(tokens, kv_h, g, d)
+    if g_pad != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+    kc = jnp.swapaxes(k_cache, 0, 1)  # [KV_H, NB, BS, D]
+    vc = jnp.swapaxes(v_cache, 0, 1)
+    out = _ragged_call(qg, kc, vc, block_tables.astype(jnp.int32),
+                       kv_lens.astype(jnp.int32),
+                       tok_lane.astype(jnp.int32),
+                       tok_pos.astype(jnp.int32), float(sm_scale))
+    return out[:, :, :g, :].reshape(tokens, h, d)
+
+
+# above this many packed tokens the ref tiles its per-token window
+# gather: an untiled T x window_capacity gather is O(T * max_seq) memory,
+# which a monolithic multi-k-token prefill chunk would blow into GBs
+_REF_TOKEN_TILE = 128
+
+
+def paged_attention_ragged_ref(q, k_cache, v_cache, block_tables, kv_lens,
+                               tok_lane, tok_pos, sm_scale=None):
+    """XLA reference for the ragged kernel (also the CPU fallback).
+
+    Same gather + masked-softmax structure as `paged_attention_ref`, per
+    packed token; guard rows (tok_pos < 0) come back exactly zero. Large
+    packed buffers (T > _REF_TOKEN_TILE) stream through `lax.map` token
+    tiles so the gathered windows stay bounded — each row's reduction is
+    unchanged, only how many rows are materialized at once."""
+    tokens, h, d = q.shape
+    nb, kv_h, bs, _ = k_cache.shape
+    g = h // kv_h
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    k = jnp.take(k_cache, block_tables, axis=0)   # [B, W, KV_H, BS, D]
+    v = jnp.take(v_cache, block_tables, axis=0)
+    max_s = block_tables.shape[1] * bs
+    k = jnp.swapaxes(k, 2, 3).reshape(block_tables.shape[0], max_s, kv_h, d)
+    v = jnp.swapaxes(v, 2, 3).reshape(block_tables.shape[0], max_s, kv_h, d)
+    wpos = jnp.arange(max_s, dtype=jnp.int32)
+
+    def tile(args):
+        qg, lane, pos = args                      # [t, KV_H, G, D] / [t]
+        kt = jnp.take(k, lane, axis=0)            # [t, max_s, KV_H, D]
+        vt = jnp.take(v, lane, axis=0)
+        s = jnp.einsum("thgd,tshd->thgs", qg.astype(jnp.float32),
+                       kt.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        mask = wpos[None, :] <= pos[:, None]                 # [t, max_s]
+        s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("thgs,tshd->thgd", p, vt.astype(jnp.float32))
+        return jnp.where((pos >= 0)[:, None, None, None], out, 0.0)
+
+    qg = q.reshape(tokens, kv_h, g, d)
+    if tokens <= _REF_TOKEN_TILE:
+        out = tile((qg, tok_lane, tok_pos))
+        return out.reshape(tokens, h, d).astype(q.dtype)
+    tile_n = _REF_TOKEN_TILE
+    pad = (-tokens) % tile_n
+    qg = jnp.pad(qg, ((0, pad), (0, 0), (0, 0), (0, 0)))
+    lane = jnp.pad(tok_lane, (0, pad))
+    pos = jnp.pad(tok_pos, (0, pad), constant_values=-1)
+    n_tiles = (tokens + pad) // tile_n
+    out = jax.lax.map(tile, (qg.reshape(n_tiles, tile_n, kv_h, g, d),
+                             lane.reshape(n_tiles, tile_n),
+                             pos.reshape(n_tiles, tile_n)))
+    out = out.reshape(n_tiles * tile_n, kv_h, g, d)[:tokens]
+    return out.reshape(tokens, h, d).astype(q.dtype)
+
+
 def paged_attention_verify_ref(q, k_cache, v_cache, block_tables,
                                context_lens, sm_scale=None):
     """XLA reference for the verify pass (also the CPU fallback)."""
@@ -350,6 +569,30 @@ def write_kv_to_cache(k, v, k_cache, v_cache, block_tables, start_pos):
     return kc, vc
 
 
+def write_kv_to_cache_ragged(k, v, k_cache, v_cache, block_tables,
+                             tok_lane, tok_pos):
+    """Scatter packed ragged K/V tokens into the block pool.
+
+    k/v: [T, KV_H, D] — one new token per packed slot, landing at
+    absolute position `tok_pos[t]` of lane `tok_lane[t]`'s block table.
+    Guard slots (tok_pos < 0) are routed to an out-of-bounds flat index,
+    which jnp scatter DROPS under jit — no guard-block lease needed for
+    the ragged write path. Returns updated (k_cache, v_cache)."""
+    tokens, kv_h, d = k.shape
+    nb, _, bs, _ = k_cache.shape
+    pos = jnp.maximum(tok_pos, 0)
+    blk = block_tables[tok_lane, pos // bs]                   # [T]
+    flat = jnp.where(tok_pos >= 0, blk * bs + pos % bs,
+                     jnp.int32(nb * bs))                      # OOB -> drop
+    kc = k_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
+    vc = v_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
+    kc = kc.at[flat].set(k)
+    vc = vc.at[flat].set(v)
+    kc = kc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+    vc = vc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+    return kc, vc
+
+
 def supported(q_shape, dtype) -> bool:
     if not _support.kernels_enabled():
         return False
@@ -369,5 +612,18 @@ def verify_supported(q_shape, dtype) -> bool:
     if q_shape[-1] > 256:
         return False
     if q_shape[1] > 64:          # S*Gp rows must stay a small VMEM tile
+        return False
+    return str(np.dtype(dtype)) in ("float32", "bfloat16", "float16")
+
+
+def ragged_supported(q_shape, dtype) -> bool:
+    """Gate for `paged_attention_ragged` (q: [T, H, D]). The per-tile
+    VMEM footprint is one token's head-group band — independent of T —
+    so only the head dim and dtype gate."""
+    if not _support.kernels_enabled():
+        return False
+    if len(q_shape) != 3:
+        return False
+    if q_shape[-1] > 256:
         return False
     return str(np.dtype(dtype)) in ("float32", "bfloat16", "float16")

@@ -67,7 +67,21 @@ class EngineCore(Protocol):
         [B, S] (pending last token + S-1 drafts), `context_lens` counting
         the cache INCLUDING all S tokens, returns logits [B, S, V] where
         row i is the distribution after tokens[:, i]. Fixed S every call
-        so the steady state never recompiles."""
+        so the steady state never recompiles. A special case of
+        `ragged_step` (q_len == S for every lane) and implemented on top
+        of it by both in-tree engines."""
+        ...
+
+    def ragged_step(self, tokens: np.ndarray, q_lens: np.ndarray,
+                    kv_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        """ONE fixed-shape step over a packed ragged batch: tokens [T]
+        lane-major (lane i owns q_lens[i] consecutive slots, token j at
+        position kv_lens[i] - q_lens[i] + j; q_len 0 = empty lane),
+        returns logits [T, V]. The serving scheduler's only decode-path
+        dispatch — decode lanes and chunked-prefill tokens share it, so
+        the steady state holds ONE executable with no prompt-length or
+        bucket shape family."""
         ...
 
 
@@ -118,31 +132,64 @@ def _mlp_decode(params, cache, tokens, ctx_lens, tables, *, block_size):
     return logits.astype(jnp.float32), cache
 
 
+def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
+                      block_size):
+    """Shared ragged body: packed tokens [T] + per-lane (q_len, kv_len)
+    metadata. Token t embeds, writes its embedding at its absolute
+    position (guard slots' writes are OOB-dropped), and conditions on
+    (own embedding, masked mean of its lane's window through `tok_pos`)
+    — exactly what a sequence of decode_step calls computes."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas.paged_attention import ragged_metadata
+
+    t = tokens.shape[0]
+    nb = cache.shape[0]
+    maxb = tables.shape[1]
+    tok_lane, tok_pos = ragged_metadata(q_lens, kv_lens, t)
+    x = jnp.take(params["embed"], tokens, axis=0)            # [T, D]
+    pos = jnp.maximum(tok_pos, 0)
+    blocks = tables[tok_lane, pos // block_size]             # [T]
+    blocks = jnp.where(tok_pos >= 0, blocks, jnp.int32(nb))  # OOB -> drop
+    cache = cache.at[blocks, pos % block_size].set(x)
+    window = jnp.take(cache, tables, axis=0).reshape(
+        tables.shape[0], maxb * block_size, -1)              # [B, W, D]
+    window = jnp.take(window, tok_lane, axis=0)              # [T, W, D]
+    wpos = jnp.arange(maxb * block_size, dtype=jnp.int32)
+    mask = (wpos[None, :] <= tok_pos[:, None]).astype(x.dtype)
+    mean = (window * mask[..., None]).sum(1) / jnp.maximum(
+        mask.sum(1, keepdims=True), 1.0)                     # [T, D]
+    logits = _mlp_head(params, x, mean)
+    return logits.astype(jnp.float32), cache
+
+
+def _mlp_ragged(params, cache, tokens, q_lens, kv_lens, tables, *,
+                block_size):
+    from ..framework import monitor
+
+    # trace-time only — the ragged step IS the serving decode program
+    # (decode_retraces keeps the zero-recompile suite's counter name);
+    # ragged_retraces pins the one-executable-per-composition claim
+    monitor.inc("serving.decode_retraces")
+    monitor.inc("serving.ragged_retraces")
+    return _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens,
+                             tables, block_size=block_size)
+
+
 def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
+    """Speculative verify as a special case of the ragged step: every
+    lane is a fixed q_len == S window of the packed buffer."""
     import jax.numpy as jnp
 
     from ..framework import monitor
 
     monitor.inc("serving.verify_retraces")  # trace-time only
     b, s = tokens.shape
-    maxb = tables.shape[1]
-    x = jnp.take(params["embed"], tokens, axis=0)            # [B, S, D]
-    pos = jnp.maximum(
-        ctx_lens[:, None] - s + jnp.arange(s, dtype=jnp.int32)[None, :],
-        0)                                                   # [B, S]
-    blocks = jnp.take_along_axis(tables, pos // block_size, axis=1)
-    cache = cache.at[blocks.reshape(-1), (pos % block_size).reshape(-1)].set(
-        x.reshape(b * s, -1))
-    window = jnp.take(cache, tables.reshape(-1), axis=0).reshape(
-        b, maxb * block_size, -1)                            # [B, W, D]
-    wpos = jnp.arange(maxb * block_size, dtype=jnp.int32)
-    # query i conditions on positions <= its own (same mask decode_step
-    # applies with ctx_lens = pos + 1), per verify row
-    mask = (wpos[None, None, :] <= pos[:, :, None]).astype(x.dtype)
-    mean = (window[:, None] * mask[..., None]).sum(2) / jnp.maximum(
-        mask.sum(2, keepdims=True), 1.0)                     # [B, S, D]
-    logits = _mlp_head(params, x, mean)
-    return logits.astype(jnp.float32), cache
+    q_lens = jnp.full((b,), s, jnp.int32)
+    logits, cache = _mlp_ragged_stack(
+        params, cache, tokens.reshape(b * s), q_lens,
+        ctx_lens.astype(jnp.int32), tables, block_size=block_size)
+    return logits.reshape(b, s, -1), cache
 
 
 def _mlp_head(params, last, mean):
@@ -202,6 +249,9 @@ class MLPLMEngine:
         self._verify = jax.jit(
             functools.partial(_mlp_verify, block_size=block_size),
             donate_argnums=(1,))
+        self._ragged = jax.jit(
+            functools.partial(_mlp_ragged, block_size=block_size),
+            donate_argnums=(1,))
 
     def respawn(self) -> "MLPLMEngine":
         """Build a fresh engine with IDENTICAL weights (seed-derived) and
@@ -216,8 +266,12 @@ class MLPLMEngine:
         its own call arrays and lowers the pair for
         `cost_analysis()`/`memory_analysis()` — compiler-reported FLOPs
         per dispatch, cached alongside the executable. Optional on
-        EngineCore: engines without it simply have no CostCard."""
-        fn = {"prefill": self._prefill, "decode": self._decode,
+        EngineCore: engines without it simply have no CostCard. The
+        serving "decode" phase maps to the ragged step (the scheduler's
+        only decode program); "decode_legacy" keeps the single-token
+        executable reachable for microbenches."""
+        fn = {"prefill": self._prefill, "decode": self._ragged,
+              "ragged": self._ragged, "decode_legacy": self._decode,
               "verify": self._verify}[phase]
         return fn, (self.params, self.cache)
 
@@ -250,11 +304,25 @@ class MLPLMEngine:
         """Multi-token verify pass; see `EngineCore.verify_step`. Token i
         of row b lands at position context_lens[b] - S + i and conditions
         on (its own embedding, masked mean through its position) — exactly
-        what a sequence of S `decode_step` calls would compute."""
+        what a sequence of S `decode_step` calls would compute. Rides the
+        ragged step (q_len == S per lane)."""
         import jax.numpy as jnp
 
         logits, self.cache = self._verify(
             self.params, self.cache, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32))
+        return logits
+
+    def ragged_step(self, tokens: np.ndarray, q_lens: np.ndarray,
+                    kv_lens: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        """Packed ragged step; see `EngineCore.ragged_step`."""
+        import jax.numpy as jnp
+
+        logits, self.cache = self._ragged(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(q_lens, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32),
             jnp.asarray(block_tables, jnp.int32))
         return logits

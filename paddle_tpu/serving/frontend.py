@@ -96,6 +96,7 @@ class ServingFrontend:
                  watchdog: Optional[WatchdogConfig] = None,
                  engine_factory=None,
                  stall_after: int = 512,
+                 prefill_chunk_tokens: int = 32,
                  clock=time.perf_counter):
         """`spec`: optional `SpecDecodeConfig` enabling speculative
         decoding (proposer + fixed draft length K) for every request
@@ -110,7 +111,10 @@ class ServingFrontend:
         run). `stall_after`: with
         no watchdog, `run_until_idle`/`stream` raise `EngineStalled`
         after this many consecutive zero-progress scheduler steps
-        instead of spinning on a wedged engine. `clock`: time source for
+        instead of spinning on a wedged engine.
+        `prefill_chunk_tokens`: per-step pending-prompt token budget for
+        chunked prefill (docs/SERVING.md "Ragged batching & chunked
+        prefill" — the TPOT-vs-TTFT knob). `clock`: time source for
         deadlines, latency stamps, and stall detection — shared with the
         scheduler so fake-clock tests never mix time bases."""
         self.metrics = metrics or ServingMetrics()
@@ -119,6 +123,7 @@ class ServingFrontend:
                                    max_queue=max_queue, spec=spec,
                                    admission=admission, watchdog=watchdog,
                                    engine_factory=engine_factory,
+                                   prefill_chunk_tokens=prefill_chunk_tokens,
                                    clock=clock)
         self.default_timeout_s = default_timeout_s
         self.stall_after = stall_after

@@ -117,9 +117,24 @@ class ServingMetrics:
         # leading class so the counter space stays bounded
         monitor.inc(f"serving.engine_restarts.{reason.split(':', 1)[0]}")
 
-    def on_prefill(self, num_tokens: int):
-        monitor.inc("serving.prefills")
+    def on_prefill_chunk(self, num_tokens: int):
+        """`num_tokens` of pending-prompt context entered the cache via
+        one ragged-step chunk (chunked prefill)."""
         monitor.inc("serving.prefill_tokens", num_tokens)
+
+    def on_prefill_done(self):
+        """A request's full context finished prefilling (its final chunk
+        committed). `serving.prefills` therefore counts completed
+        prefills — one per (re-)admission, as it always did — while
+        `prefill_tokens` advances chunk by chunk."""
+        monitor.inc("serving.prefills")
+
+    def on_ragged_step(self, prefill_tokens: int, decode_lanes: int):
+        """Per-step ragged batch composition: how many pending-prompt
+        tokens and decode lanes shared this round's ONE fixed-shape
+        dispatch — the live view of chunked prefill interleaving."""
+        monitor.set_gauge("serving.step_prefill_tokens", prefill_tokens)
+        monitor.set_gauge("serving.step_decode_lanes", decode_lanes)
 
     def on_first_token(self, req):
         t = req.ttft()

@@ -7,16 +7,24 @@ the slots (admit / finish mid-batch / preempt), the program never changes
 shape, so the steady state performs ZERO recompiles.
 
 Policy (documented in docs/SERVING.md):
-- admission: FIFO from the waiting queue into free slots; a request is
-  admitted when its (bucket-padded) prompt allocation succeeds. Pool
-  exhaustion (`KVCacheExhausted`) leaves it queued — never crashes.
+- admission: FIFO from the waiting queue into free slots; admission
+  leases only the sequence id (one block) — the prompt's KV enters the
+  cache chunk-by-chunk through the ragged step, sized to the TRUE
+  context (no bucket padding, no `trim`-back). Pool exhaustion
+  (`KVCacheExhausted`) leaves it queued — never crashes.
 - load shedding (optional `AdmissionConfig`): watermark latches with
   hysteresis over queue depth, queued `max_new_tokens` cost, and KV
   utilization, plus deadline-aware early shedding — overload degrades to
   fast SHED responses instead of collapsing TTFT for everyone.
-- prefill: per-request, prompt right-padded to a power-of-two bucket so
-  prefill compiles O(log max_seq) programs; surplus padding blocks are
-  returned via `BlockCacheManager.trim` right after.
+- chunked prefill: every step packs the decode lanes (one token each)
+  plus at most `prefill_chunk_tokens` of pending-prompt tokens into ONE
+  fixed-shape `engine.ragged_step` dispatch over a packed token buffer
+  of `max_batch_size + prefill_chunk_tokens` slots. A 32k-token prompt
+  advances chunk-by-chunk while decode lanes keep emitting a token
+  every step — prefill can no longer stall decode TPOT, and the steady
+  state holds ONE executable for every batch composition and prompt
+  length (no bucket family, no prompt-length recompiles). The first
+  token samples when the final chunk completes.
 - preemption: when a RUNNING sequence cannot grow (pool exhausted on a
   block boundary), the most-recently-admitted other sequence is evicted
   back to the FRONT of the queue (LIFO victim, FIFO service order); its
@@ -130,6 +138,20 @@ class Request:
         self.t_finish: Optional[float] = None
         self._last: Optional[int] = None      # sampled, KV not yet written
         self._admit_seq = -1                  # admission order (victim pick)
+        # chunked-prefill cursor: context tokens whose KV is already in
+        # cache (reset at every (re-)admission; the target snapshot is
+        # taken then too, so re-prefill after preemption replays the
+        # full prompt + kept tokens)
+        self._prefill_ctx = np.zeros((0,), np.int32)
+        self._prefill_pos = 0
+        self._chunks = 0
+        self._t_admit: Optional[float] = None
+
+    @property
+    def prefilling(self) -> bool:
+        """True while context KV is still entering the cache chunk-wise
+        (the lane contributes prompt chunks, not decode tokens)."""
+        return self._prefill_pos < len(self._prefill_ctx)
 
     @property
     def seq_id(self) -> int:
@@ -180,11 +202,26 @@ class Scheduler:
                  watchdog: Optional[WatchdogConfig] = None,
                  engine_factory: Optional[Callable[[], EngineCore]] = None,
                  nan_checks: bool = True,
+                 prefill_chunk_tokens: int = 32,
                  clock: Callable[[], float] = time.perf_counter):
+        """`prefill_chunk_tokens`: per-step token budget for pending
+        prompts — the packed ragged dispatch holds `max_batch_size +
+        prefill_chunk_tokens` token slots. Larger chunks finish prefill
+        in fewer steps (better TTFT); smaller chunks bound how much a
+        long prompt can stretch any single step (better decode TPOT
+        under mixed traffic). See docs/SERVING.md for tuning."""
+        if prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1, got "
+                             f"{prefill_chunk_tokens}")
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
         self.max_queue = max_queue
         self.spec = spec
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        # the packed query buffer: every slot may decode one token, plus
+        # the chunk budget — FIXED for the scheduler's lifetime, so the
+        # ragged step is one compiled executable
+        self.ragged_tokens = engine.max_batch_size + self.prefill_chunk_tokens
         self.engine_factory = engine_factory
         self.nan_checks = nan_checks
         self._overload = OverloadController(admission) if admission else None
@@ -211,7 +248,9 @@ class Scheduler:
         self._pending_stall: Optional[str] = None
         self._broken: Optional[str] = None   # rebind failed mid-restart
         self._finite_fn = None               # jitted NaN screen, lazy
+        self._gather_fn = None               # jitted last-row gather, lazy
         self._last_decode_dt: Optional[float] = None
+        self._chunk_progress = 0             # prefill tokens last round
         self._bind_manager(engine.manager)
 
     def _bind_manager(self, mgr):
@@ -234,10 +273,6 @@ class Scheduler:
         # What one sequence can ever hold: pool minus the guard (and minus
         # blocks other users of a shared engine already lease).
         self._usable_blocks = min(mgr.free_blocks, mgr.max_blocks_per_seq)
-        self._buckets = [mgr.block_size]
-        max_tokens = mgr.max_blocks_per_seq * mgr.block_size
-        while self._buckets[-1] < max_tokens:
-            self._buckets.append(min(self._buckets[-1] * 2, max_tokens))
 
     # ---- waiting-queue bookkeeping (cost-accounted) ----
     def _queue_push(self, req: Request, front: bool = False):
@@ -345,10 +380,12 @@ class Scheduler:
         self._expire(now)
         admitted = self._admit(now)
         produced = self._decode(now)
-        # progress = tokens, admissions, or terminal transitions; a
-        # non-idle scheduler sustaining zero progress is wedged — the
-        # watchdog's restart trigger and `EngineStalled`'s evidence
-        if produced > 0 or admitted > 0 or self._finish_events > finish_mark:
+        # progress = tokens, prefill-chunk advancement, admissions, or
+        # terminal transitions; a non-idle scheduler sustaining zero
+        # progress is wedged — the watchdog's restart trigger and
+        # `EngineStalled`'s evidence
+        if produced > 0 or admitted > 0 or self._chunk_progress > 0 \
+                or self._finish_events > finish_mark:
             self._zero_progress = 0
         else:
             self._zero_progress += 1
@@ -675,112 +712,104 @@ class Scheduler:
                 self._finish(req, RequestStatus.TIMED_OUT,
                              "deadline_while_running", slot=i)
 
-    def _bucket(self, n: int) -> int:
-        for b in self._buckets:
-            if b >= n:
-                return b
-        return self._buckets[-1]
-
-    def _admit_allocate(self, req: Request, n_ctx: int) -> Optional[int]:
-        """Lease KV for an admission: bucket-padded first, unpadded when
-        the padding overshot (the per-seq cap, or a pool with no runners
-        left to free blocks). Returns the allocated length, or None for
-        a plain pool wait (runners will free blocks — stay queued).
-        Injected/corrupt cache state propagates to the caller's single
-        fault handler."""
-        mgr = self.engine.manager
-        try:
-            bucket = self._bucket(n_ctx)
-            mgr.allocate(req.seq_id, bucket)
-            return bucket
-        except (KVCacheExhausted, SequenceTooLong) as e:
-            if isinstance(e, KVCacheExhausted) and self.num_running > 0:
-                return None
-            try:
-                mgr.allocate(req.seq_id, n_ctx)
-                return n_ctx
-            except (KVCacheExhausted, SequenceTooLong):
-                return None
-
     def _admit(self, now: float) -> int:
+        """Place queued requests into free slots. Admission leases only
+        the sequence id (a zero-token allocation = one block); the
+        prompt's KV then enters the cache chunk-by-chunk through the
+        ragged step — no bucket padding, no per-admission prefill
+        dispatch, and the lease always tracks the TRUE context length.
+        The first token samples when the final chunk completes (inside
+        the ragged round's commit loop)."""
         mgr = self.engine.manager
         admitted = 0
         while self.waiting and None in self.slots:
             req = self.waiting[0]
             ctx = req.context_tokens()
+            # admit only when the WHOLE context could lease right now —
+            # the same admission pressure the full-prefill scheduler had
+            # (it physically leased the full context at admission, so a
+            # second admission saw the first's blocks already gone; here
+            # that outstanding demand is the prefill DEBT of admitted
+            # lanes still mid-chunking, and must be subtracted or two
+            # large prompts would both admit against the same free count
+            # and preempt-churn mid-prefill).
+            debt = sum(
+                max(0, mgr.blocks_needed(len(r._prefill_ctx))
+                    - mgr.seq_blocks(r.seq_id))
+                for r in self.slots if r is not None and r.prefilling)
+            if mgr.blocks_needed(len(ctx)) > mgr.free_blocks - debt:
+                break                  # blocks return as runners finish
             try:
-                bucket = self._admit_allocate(req, len(ctx))
-            except Exception:              # injected/corrupt cache state
+                mgr.allocate(req.seq_id, 0)
+            except (KVCacheExhausted, SequenceTooLong):
+                break
+            except Exception:          # injected/corrupt cache state
                 self._queue_pop()
                 self._isolated(req, "engine_fault:cache", "cache",
                                in_slot=False)
                 continue
-            if bucket is None:
-                break
             self._queue_pop()
             slot = self.slots.index(None)
-            obs_on = _obs.enabled()
-            if obs_on:
-                t_admit = self._clock()
-                self._obs_req(req, "admitted", t0=t_admit, slot=slot,
-                              queue_wait_ms=round(
-                                  (t_admit - req.t_submit) * 1e3, 3)
-                              if req.t_submit is not None else None)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(ctx)] = ctx
-            tables = mgr.block_table_array([req.seq_id])
-            from ..profiler import RecordEvent
-
-            try:
-                with RecordEvent("serving.prefill"):
-                    logits, flagged = self._dispatch(
-                        "prefill", self.engine.prefill, padded, tables,
-                        np.asarray([len(ctx)], np.int32))
-            except Exception:
-                # prefill is per-request: attribution is trivial
-                mgr.free(req.seq_id)
-                self._isolated(req, "engine_fault:prefill", "prefill",
-                               in_slot=False)
-                continue
-            if flagged or (self.nan_checks
-                           and not bool(self._finite_rows(logits)[0])):
-                mgr.free(req.seq_id)
-                self._isolated(req, "nan_logits", "prefill",
-                               in_slot=False)
-                continue
-            mgr.trim(req.seq_id, len(ctx))
-            self.metrics.on_prefill(len(ctx))
-            if obs_on:
-                self._obs_req(req, "prefill", t0=t_admit,
-                              t1=self._clock(), tokens=int(len(ctx)),
-                              bucket=bucket)
-            was_preempted = req.status is RequestStatus.PREEMPTED
+            # snapshot the prefill target HERE: for a preempted
+            # re-admission it includes the kept tokens, so the replay is
+            # token-deterministic; the pending `_last` (when present)
+            # stays pending and decodes after the chunks complete
+            req._prefill_ctx = ctx
+            req._prefill_pos = 0
+            req._chunks = 0
+            req._t_admit = self._clock()
             req.status = RequestStatus.RUNNING
             req._admit_seq = next(self._admit_counter)
             self.slots[slot] = req
             admitted += 1
-            if not was_preempted:
-                try:
-                    _faults.check("serve.sample")
-                    tok = int(sample_tokens(logits, *self._sampling_arrays(
-                        [req]))[0])
-                except Exception:
-                    # the request already owns its slot; single-request
-                    # commit point, so fail it and keep admitting
-                    self._isolated(req, "engine_fault:sample", "sample",
-                                   slot=slot)
-                    continue
-                req.generated.append(tok)
-                req._last = tok
-                if req.t_first_token is None:
-                    req.t_first_token = self._clock()
-                    self.metrics.on_first_token(req)
-                if req.stream_cb is not None:
-                    req.stream_cb(req, tok)
-                self._maybe_finish_on_token(req, tok, slot)
-            # preempted re-admissions keep their pending `_last`; the
-            # prefill logits above are for a token already sampled — drop.
+            if _obs.enabled():
+                self._obs_req(req, "admitted", t0=req._t_admit, slot=slot,
+                              queue_wait_ms=round(
+                                  (req._t_admit - req.t_submit) * 1e3, 3)
+                              if req.t_submit is not None else None)
         return admitted
+
+    def _grow_chunk(self, req: Request, slot: int, want: int) -> int:
+        """Reserve cache slots for the next `want` prefill-chunk tokens.
+        Under pool pressure the chunk shrinks to what the free pool (plus
+        the last leased block's slack) holds before anyone is preempted —
+        the prefill analog of `_grow_n`'s drop-the-drafts degrade.
+        Returns tokens reserved (0 = nothing this round, or the request
+        left the batch)."""
+        mgr = self.engine.manager
+        while True:
+            try:
+                mgr.append_tokens(req.seq_id, want)
+                return want
+            except SequenceTooLong:
+                cap = mgr.max_blocks_per_seq * mgr.block_size \
+                    - mgr.seq_len(req.seq_id)
+                if cap >= 1:
+                    want = min(want, cap)
+                    continue
+                # unreachable for submit-screened prompts (ctx + 1 fits
+                # the per-seq cap); terminal rather than a spin if an
+                # engine swap shrank the cap under a live request
+                self._finish(req, RequestStatus.FINISHED, "length_cap",
+                             slot=slot)
+                return 0
+            except KVCacheExhausted as e:
+                # capacity already in hand: the leased blocks' unused
+                # tail (a fresh admission holds one ENTIRELY empty
+                # block), plus whatever the free pool still has
+                slack = mgr.seq_blocks(req.seq_id) * mgr.block_size \
+                    - mgr.seq_len(req.seq_id)
+                fit = mgr.free_blocks * mgr.block_size + slack
+                if 1 <= fit < want:
+                    want = fit
+                    continue
+                if _obs.enabled():
+                    self._obs_oom("kv_exhausted", need=e.need, free=e.free,
+                                  total=e.total, seq_id=req.seq_id)
+                if not self._preempt_one(exclude=req):
+                    # sole lane over an externally-held pool: wait (the
+                    # stall detectors own the pathological case)
+                    return 0
 
     @staticmethod
     def _sampling_arrays(reqs):
@@ -836,112 +865,198 @@ class Scheduler:
                           tokens_kept=len(req.generated))
         return True
 
+    def _gather_rows(self, logits, rows: np.ndarray):
+        """Device-side gather of each lane's last-token row: [T, V] ->
+        [B, V] without materializing the packed logits on host (same
+        rationale as `_finite_rows`). One trace, cached."""
+        import jax
+
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(lambda x, idx: x[idx])
+        return self._gather_fn(logits, rows)
+
     def _decode(self, now: float) -> int:
+        """One ragged round: decode lanes (one token each) plus up to
+        `prefill_chunk_tokens` pending-prompt tokens, packed into ONE
+        fixed-shape `engine.ragged_step` dispatch. Returns decode tokens
+        committed (prefill progress is tracked separately)."""
+        self._chunk_progress = 0
         if self.spec is not None:
             return self._decode_spec(now)
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return 0
+        mgr = self.engine.manager
         # grow (and possibly preempt) before building the batch arrays
-        grown = []
+        decode_lanes = []              # (slot, req)
+        chunks = []                    # (slot, req, n_tokens, pre_len)
+        budget = self.prefill_chunk_tokens
         for i, req in active:
             if self.slots[i] is not req:
                 continue
-            try:
-                ok = self._grow(req, i)
-            except Exception:              # injected/corrupt cache state:
-                self._isolated(req, "engine_fault:cache", "cache", slot=i)
-                continue                   # attribution is trivial
-            if ok:
-                grown.append((i, req))
-        active = [(i, r) for i, r in grown if self.slots[i] is r]
-        if not active:
+            if req.prefilling:
+                if budget <= 0:
+                    continue           # next step's budget serves it
+                rem = len(req._prefill_ctx) - req._prefill_pos
+                pre_len = mgr.seq_len(req.seq_id)
+                try:
+                    got = self._grow_chunk(req, i, min(rem, budget))
+                except Exception:      # injected/corrupt cache state
+                    self._isolated(req, "engine_fault:cache", "cache",
+                                   slot=i)
+                    continue
+                if got:
+                    budget -= got
+                    chunks.append((i, req, got, pre_len))
+            else:
+                try:
+                    ok = self._grow(req, i)
+                except Exception:      # injected/corrupt cache state:
+                    self._isolated(req, "engine_fault:cache", "cache",
+                                   slot=i)
+                    continue           # attribution is trivial
+                if ok:
+                    decode_lanes.append((i, req))
+        # growth-path preemptions may have evicted earlier entries
+        decode_lanes = [(i, r) for i, r in decode_lanes
+                        if self.slots[i] is r]
+        chunks = [(i, r, n, p) for i, r, n, p in chunks
+                  if self.slots[i] is r]
+        if not decode_lanes and not chunks:
             return 0
-        mgr = self.engine.manager
         B = len(self.slots)
-        tokens = np.zeros((B,), np.int32)
-        lens = np.ones((B,), np.int32)
+        T = self.ragged_tokens
+        tokens = np.zeros((T,), np.int32)
+        q_lens = np.zeros((B,), np.int32)
+        kv_lens = np.zeros((B,), np.int32)
         tables = np.full((B, mgr.max_blocks_per_seq), self._pad_block,
                          np.int32)
-        for i, req in active:
-            tokens[i] = req._last
-            lens[i] = mgr.seq_len(req.seq_id)
+        rows = np.zeros((B,), np.int32)   # last packed row per lane
+        decode_set = {i for i, _r in decode_lanes}
+        chunk_of = {i: (n, p) for i, _r, n, p in chunks}
+        pre_lens = {}                     # seq_id -> pre-round cache len
+        cursor = 0
+        for i in range(B):                # slot order = packing order
+            req = self.slots[i]
+            if req is None:
+                continue
+            if i in decode_set:
+                tokens[cursor] = req._last
+                q_lens[i] = 1
+                kv_lens[i] = mgr.seq_len(req.seq_id)
+                pre_lens[req.seq_id] = int(kv_lens[i]) - 1
+                rows[i] = cursor
+                cursor += 1
+            elif i in chunk_of:
+                n, p = chunk_of[i]
+                tokens[cursor:cursor + n] = req._prefill_ctx[
+                    req._prefill_pos:req._prefill_pos + n]
+                q_lens[i] = n
+                kv_lens[i] = mgr.seq_len(req.seq_id)   # == p + n
+                pre_lens[req.seq_id] = p
+                rows[i] = cursor + n - 1
+                cursor += n
+            else:
+                continue
             tables[i] = mgr.block_table_array([req.seq_id])[0]
+        all_lanes = decode_lanes + [(i, r) for i, r, _n, _p in chunks]
         from ..profiler import RecordEvent
 
         def probe(i, req):
             """Replay ONE lane of the failed step (same fixed shapes, so
-            no recompile; its KV write is idempotent with the retry)."""
-            t = np.zeros((B,), np.int32)
-            t[i] = tokens[i]
-            ln = np.ones((B,), np.int32)
-            ln[i] = lens[i]
+            no recompile; KV writes are position-indexed and idempotent
+            with the retry)."""
+            n = int(q_lens[i])
+            start = int(rows[i]) - n + 1
+            t = np.zeros((T,), np.int32)
+            t[:n] = tokens[start:start + n]
+            q = np.zeros((B,), np.int32)
+            q[i] = n
+            kv = np.zeros((B,), np.int32)
+            kv[i] = kv_lens[i]
             tb = np.full((B, mgr.max_blocks_per_seq), self._pad_block,
                          np.int32)
             tb[i] = tables[i]
-            return np.asarray(self.engine.decode_step(t, ln, tb))[i]
+            # the lane's WHOLE packed band: a NaN confined to an earlier
+            # chunk row must still convict this lane (the caller's
+            # finiteness check reduces over everything returned)
+            return np.asarray(self.engine.ragged_step(t, q, kv, tb))[:n]
 
         def rollback(survivors):
-            # undo this step's _grow so the next round replays cleanly
+            # undo this round's growth so the next round replays cleanly
             for i, r in survivors:
-                mgr.trim(r.seq_id, int(lens[i]) - 1)
+                mgr.trim(r.seq_id, pre_lens[r.seq_id])
 
         try:
             with RecordEvent("serving.decode_step"):
                 logits, flagged = self._dispatch(
-                    "decode", self.engine.decode_step, tokens, lens, tables)
+                    "decode", self.engine.ragged_step, tokens, q_lens,
+                    kv_lens, tables)
         except Exception as e:
-            self._step_fault("decode", e, active, probe=probe,
+            self._step_fault("decode", e, all_lanes, probe=probe,
                              rollback=rollback)
             return 0
         if flagged or self.nan_checks:
             if flagged:              # injection path: poison one lane
                 arr = np.array(logits)
-                arr[active[0][0]] = np.nan
+                arr[int(rows[all_lanes[0][0]])] = np.nan
                 logits = arr
                 finite = np.isfinite(arr).all(axis=-1)
-            else:                    # hot path: [B] bool fetch only
+            else:                    # hot path: [T] bool fetch only
                 finite = self._finite_rows(logits)
-            for i, req in active:
-                if not finite[i]:
+            for i, req in all_lanes:
+                n = int(q_lens[i])
+                start = int(rows[i]) - n + 1
+                if not bool(np.asarray(finite[start:start + n]).all()):
                     # the garbage KV went into this lane's own blocks;
                     # freeing the sequence discards it
                     self._isolated(req, "nan_logits", "decode", slot=i)
-            active = [(i, r) for i, r in active if self.slots[i] is r]
-            if not active:
+            all_lanes = [(i, r) for i, r in all_lanes
+                         if self.slots[i] is r]
+            if not all_lanes:
                 return 0
+            decode_lanes = [(i, r) for i, r in decode_lanes
+                            if self.slots[i] is r]
+            chunks = [(i, r, n, p) for i, r, n, p in chunks
+                      if self.slots[i] is r]
         t_tok = self._clock()
-        # fused device sampling over ALL lanes (fixed [B, V] shape; padded
-        # lanes sample greedy and are discarded)
-        active_map = dict(active)
+        # fused device sampling over every lane's LAST packed row (fixed
+        # [B, V] shape): decode lanes commit their token; a prefill lane
+        # samples only on the round its final chunk completes (counter
+        # draw_idx 0 — exactly the draw sequential decode would make)
+        lane_sample: List[Optional[Request]] = [None] * B
+        for i, req in decode_lanes:
+            lane_sample[i] = req
+        for i, req, n, _p in chunks:
+            if req._prefill_pos + n >= len(req._prefill_ctx) \
+                    and req._last is None:
+                lane_sample[i] = req
         try:
             _faults.check("serve.sample")
-            picked = sample_tokens(logits, *self._sampling_arrays(
-                [active_map.get(i) for i in range(B)]))
+            picked = sample_tokens(self._gather_rows(logits, rows),
+                                   *self._sampling_arrays(lane_sample))
         except Exception as e:
-            self._step_fault("sample", e, active, rollback=rollback)
+            self._step_fault("sample", e, all_lanes, rollback=rollback)
             return 0
         self._step_faults = 0   # a full dispatch+sample round succeeded
         produced = 0
-        obs_on = _obs.enabled()
-        for i, req in active:
+        for i, req in decode_lanes:
             if self.slots[i] is not req:   # cancelled by a stream_cb
                 continue                   # earlier in this very loop
-            tok = int(picked[i])
-            req.generated.append(tok)
-            req._last = tok
             produced += 1
-            if req.t_first_token is None:
-                req.t_first_token = t_tok
-                self.metrics.on_first_token(req)
-            if req.stream_cb is not None:
-                req.stream_cb(req, tok)
-            if obs_on:
-                self._obs_req(req, "decode", t0=t_tok, tokens=1,
-                              total=len(req.generated))
-            self._maybe_finish_on_token(req, tok, i)
-        self._record_tpot(len(active), produced)
-        self.metrics.on_decode(produced)
+            self._commit_token(req, int(picked[i]), i, t_tok,
+                               obs_decode=True)
+        chunk_tokens = 0
+        for i, req, n, _p in chunks:
+            if self.slots[i] is not req:   # cancelled mid-commit
+                continue
+            chunk_tokens += n
+            self._commit_chunk(req, n, i, t_tok, picked[i])
+        self._chunk_progress = chunk_tokens
+        self.metrics.on_ragged_step(chunk_tokens, len(decode_lanes))
+        if decode_lanes:
+            self._record_tpot(len(decode_lanes), produced)
+            self.metrics.on_decode(produced)
         return produced
 
     # ---- speculative decoding ----
@@ -988,7 +1103,17 @@ class Scheduler:
         Shape discipline: the verify batch is always [B, K+1] tokens.
         Lanes with fewer than K drafts reserve only what they hold; the
         surplus fixed-shape KV writes land in guard-padded block-table
-        entries, never in live blocks."""
+        entries, never in live blocks.
+
+        Chunked prefill rides the SAME dispatch: a prefilling lane's
+        window carries its next (up to K+1) prompt tokens instead of
+        pending+drafts — the verify pass is itself a ragged-step special
+        case, so a prompt chunk is just a lane whose "drafts" are known
+        tokens nobody samples. A prompt is never completed mid-window:
+        the final chunk is held to exactly one token so the first-token
+        sample lands at window slot 0, whose counter-RNG draw offset (0)
+        matches what the plain path and sequential decode draw — exact
+        spec==plain parity under chunking, greedy and stochastic alike."""
         active = [(i, r) for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return 0
@@ -996,11 +1121,28 @@ class Scheduler:
         K = self.spec.num_draft_tokens
         S = K + 1
         proposer = self.spec.proposer
-        lanes = []                   # (slot, req, drafts, pre_len)
+        lanes = []             # (slot, req, toks, pre_len, prefilling)
         for i, req in active:
             if self.slots[i] is not req:
                 continue
             pre_len = mgr.seq_len(req.seq_id)
+            if req.prefilling:
+                rem = len(req._prefill_ctx) - req._prefill_pos
+                want = min(S, rem)
+                if want == rem and rem > 1:
+                    want = rem - 1      # complete next round, at slot 0
+                try:
+                    got = self._grow_chunk(req, i, want)
+                except Exception:       # injected/corrupt cache state
+                    self._isolated(req, "engine_fault:cache", "cache",
+                                   slot=i)
+                    continue
+                if got == 0:
+                    continue
+                toks = list(req._prefill_ctx[
+                    req._prefill_pos:req._prefill_pos + got])
+                lanes.append((i, req, toks, pre_len, True))
+                continue
             try:
                 drafts = list(proposer.propose(
                     req.seq_id, req.all_tokens(), K))[:K]
@@ -1013,8 +1155,9 @@ class Scheduler:
                 continue
             if got == 0:
                 continue
-            lanes.append((i, req, drafts[:got - 1], pre_len))
-        lanes = [(i, r, d, p) for i, r, d, p in lanes if self.slots[i] is r]
+            lanes.append((i, req, [req._last] + drafts[:got - 1], pre_len,
+                          False))
+        lanes = [ln for ln in lanes if self.slots[ln[0]] is ln[1]]
         if not lanes:
             return 0
         B = len(self.slots)
@@ -1034,16 +1177,21 @@ class Scheduler:
         tables = np.full((B, width), self._pad_block, np.int32)
         lane_reqs: List[Optional[Request]] = [None] * B
         pre_lens = {}
-        for i, req, drafts, pre_len in lanes:
-            tokens[i, 0] = req._last
-            if drafts:
-                tokens[i, 1:1 + len(drafts)] = drafts
+        for i, req, toks, pre_len, prefilling in lanes:
+            tokens[i, :len(toks)] = toks
             # uniform layout: token j sits at position pre_len + j, so
-            # ctx counts the full fixed window even when len(drafts) < K
+            # ctx counts the full fixed window even when the lane holds
+            # fewer than S real tokens (short drafts / a short chunk)
             ctx[i] = pre_len + S
             tables[i, :mgr.max_blocks_per_seq] = mgr.block_table_array(
                 [req.seq_id], pad=self._pad_block)[0]
-            lane_reqs[i] = req
+            # sampled rows matter for decode lanes always, and for a
+            # prefill lane only on its completing (one-token) chunk
+            if not prefilling:
+                lane_reqs[i] = req
+            elif req._prefill_pos + len(toks) >= len(req._prefill_ctx) \
+                    and req._last is None:
+                lane_reqs[i] = req
             pre_lens[req.seq_id] = pre_len
         from ..profiler import RecordEvent
 
@@ -1060,7 +1208,7 @@ class Scheduler:
             for i, r in survivors:
                 mgr.trim(r.seq_id, pre_lens[r.seq_id])
 
-        lane_pairs = [(i, r) for i, r, _d, _p in lanes]
+        lane_pairs = [(i, r) for i, r, _t, _p, _f in lanes]
         try:
             with RecordEvent("serving.verify_step"):
                 logits, flagged = self._dispatch(
@@ -1081,8 +1229,7 @@ class Scheduler:
                 if not finite[i]:
                     self._isolated(req, "nan_logits", "verify", slot=i)
                     lane_reqs[i] = None
-            lanes = [(i, r, d, p) for i, r, d, p in lanes
-                     if self.slots[i] is r]
+            lanes = [ln for ln in lanes if self.slots[ln[0]] is ln[1]]
             if not lanes:
                 return 0
         t_tok = self._clock()
@@ -1090,15 +1237,26 @@ class Scheduler:
             _faults.check("serve.sample")
             picked = sample_tokens(logits, *self._sampling_arrays(lane_reqs))
         except Exception as e:
-            self._step_fault("sample", e, [(i, r) for i, r, _d, _p in lanes],
+            self._step_fault("sample", e,
+                             [(i, r) for i, r, _t, _p, _f in lanes],
                              rollback=rollback)
             return 0
         self._step_faults = 0   # a full verify+sample round succeeded
         produced = proposed = accepted = 0
+        chunk_tokens = decode_lanes = 0
         obs_on = _obs.enabled()
-        for i, req, drafts, pre_len in lanes:
+        for i, req, toks, pre_len, prefilling in lanes:
             if self.slots[i] is not req:   # cancelled by a stream_cb
                 continue                   # earlier in this very loop
+            if prefilling:
+                got = len(toks)
+                chunk_tokens += got
+                # a completing chunk has got == 1 -> window slot 0, the
+                # draw offset sequential decode would use
+                self._commit_chunk(req, got, i, t_tok, picked[i, got - 1])
+                continue
+            decode_lanes += 1
+            drafts = toks[1:]
             a = 0
             while a < len(drafts) and drafts[a] == int(picked[i, a]):
                 a += 1
@@ -1108,16 +1266,9 @@ class Scheduler:
             # emit the accepted drafts (== the sampled tokens) plus the
             # bonus/correction token from the first unmatched position
             for tok in (int(picked[i, j]) for j in range(a + 1)):
-                req.generated.append(tok)
-                req._last = tok
                 produced += 1
                 committed += 1
-                if req.t_first_token is None:
-                    req.t_first_token = t_tok
-                    self.metrics.on_first_token(req)
-                if req.stream_cb is not None:
-                    req.stream_cb(req, tok)
-                self._maybe_finish_on_token(req, tok, i)
+                self._commit_token(req, tok, i, t_tok)
                 if req.status.terminal:
                     break
             if obs_on:
@@ -1127,11 +1278,55 @@ class Scheduler:
             if not req.status.terminal:
                 # roll back rejected speculation: keep pending + accepted
                 mgr.trim(req.seq_id, pre_len + 1 + a)
-        self._record_tpot(len(lanes), produced)
-        self.metrics.on_decode(produced)
-        self.metrics.on_spec(proposed=proposed, accepted=accepted,
-                             produced=produced, lanes=len(lanes))
+        self._chunk_progress = chunk_tokens
+        self.metrics.on_ragged_step(chunk_tokens, decode_lanes)
+        if decode_lanes:
+            self._record_tpot(decode_lanes, produced)
+            self.metrics.on_decode(produced)
+            self.metrics.on_spec(proposed=proposed, accepted=accepted,
+                                 produced=produced, lanes=decode_lanes)
         return produced
+
+    def _commit_chunk(self, req: Request, n: int, slot: int, t_tok: float,
+                      first_tok) -> None:
+        """Advance a lane's chunked prefill by `n` committed tokens. On
+        the round the FINAL chunk completes: account the prefill, emit
+        the request-track event, and commit the request's first token
+        (`first_tok` — ignored while chunks remain, and on a preempted
+        re-admission whose pending token already exists). The one
+        prefill-completion bookkeeping site for the plain and spec
+        paths, so their parity cannot drift."""
+        req._prefill_pos += n
+        req._chunks += 1
+        self.metrics.on_prefill_chunk(n)
+        if req.prefilling:
+            return                         # more chunks next round
+        self.metrics.on_prefill_done()
+        if _obs.enabled():
+            self._obs_req(req, "prefill", t0=req._t_admit, t1=t_tok,
+                          tokens=int(len(req._prefill_ctx)),
+                          chunks=req._chunks)
+        if req._last is None:              # fresh: the FIRST token
+            self._commit_token(req, int(first_tok), slot, t_tok)
+
+    def _commit_token(self, req: Request, tok: int, slot: int,
+                      t_tok: float, obs_decode: bool = False):
+        """Commit one sampled token: the ONE place the generated stream,
+        pending token, TTFT stamp, stream callback, and finish check
+        advance together — the decode lanes, both prefill-completion
+        sites, and the speculative accept loop share it so first-token
+        accounting can never diverge between the plain and spec paths."""
+        req.generated.append(tok)
+        req._last = tok
+        if req.t_first_token is None:
+            req.t_first_token = t_tok
+            self.metrics.on_first_token(req)
+        if req.stream_cb is not None:
+            req.stream_cb(req, tok)
+        if obs_decode and _obs.enabled():
+            self._obs_req(req, "decode", t0=t_tok, tokens=1,
+                          total=len(req.generated))
+        self._maybe_finish_on_token(req, tok, slot)
 
     def _maybe_finish_on_token(self, req: Request, tok: int, slot: int):
         if req.status.terminal:

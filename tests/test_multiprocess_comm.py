@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+from conftest import require_multiprocess_collectives
+
 _WORKER = '''
 import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -187,6 +189,7 @@ print(f"PP_OK rank={rank}", flush=True)
 
 @pytest.mark.timeout(300)
 def test_launch_two_process_collectives(tmp_path):
+    require_multiprocess_collectives()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -214,6 +217,7 @@ def test_launch_two_process_collectives(tmp_path):
 def test_launch_two_process_two_stage_pp(tmp_path):
     """Eager cross-process pipeline: stage0 sends activations, stage1 sends
     activation-grads back, both verify analytic weight gradients."""
+    require_multiprocess_collectives()
     script = tmp_path / "pp_worker.py"
     script.write_text(_PP_WORKER)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -113,18 +113,33 @@ def test_first_trace_is_not_a_retrace_cause():
                 if c["name"].startswith("serve.")]
 
 
-def test_serving_prefill_bucket_retrace_cause():
-    """A prompt landing in a new prefill bucket retraces; the cause names
-    the widened shape — the serving `*_retraces` counters gain a why."""
+def test_ragged_no_prompt_length_retrace_and_shape_cause_attribution():
+    """Prompt length no longer retraces ANYTHING — the bucket executable
+    family collapsed into one ragged program — and when the dispatch
+    shape genuinely changes (a different packed-token budget), the
+    retrace-cause tracing still names the changed shape."""
     obs.enable()
     fe = _mlp_frontend()
     rng = np.random.default_rng(0)
     fe.submit(rng.integers(1, 64, 3).tolist(), max_new_tokens=2)
     fe.run_until_idle()
+    base = monitor.get("serving.decode_retraces")
     fe.submit(rng.integers(1, 64, 9).tolist(), max_new_tokens=2)
+    fe.submit(rng.integers(1, 64, 17).tolist(), max_new_tokens=2)
     fe.run_until_idle()
+    assert monitor.get("serving.decode_retraces") == base
+    assert not [c for c in obs.retrace_causes()
+                if c["name"].startswith("serve.")]
+    # a REAL shape change — a frontend with a different chunk budget, so
+    # a different packed buffer — is still attributed with a why
+    fe2 = ServingFrontend(MLPLMEngine(vocab_size=64, hidden=16,
+                                      max_batch_size=4, num_blocks=48,
+                                      block_size=4, max_blocks_per_seq=8),
+                          prefill_chunk_tokens=8)
+    fe2.submit(rng.integers(1, 64, 3).tolist(), max_new_tokens=2)
+    fe2.run_until_idle()
     causes = [c for c in obs.retrace_causes()
-              if c["name"] == "serve.prefill"]
+              if c["name"] == "serve.decode"]
     assert causes and "shape" in causes[-1]["cause"], obs.retrace_causes()
 
 

@@ -91,6 +91,12 @@ print(f"RPC_OK rank={rank}", flush=True)
 
 @pytest.mark.timeout(300)
 def test_two_process_rpc(tmp_path):
+    # same backend gap as test_multiprocess_comm: the worker's
+    # init_parallel_env/collective path needs cross-process CPU
+    # collectives this jaxlib does not implement
+    from conftest import require_multiprocess_collectives
+
+    require_multiprocess_collectives()
     script = tmp_path / "rpc_worker.py"
     script.write_text(_RPC_WORKER)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

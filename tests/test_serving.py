@@ -505,6 +505,137 @@ class TestDeadlineCancelRaces:
 
 
 # ---------------------------------------------------------------------------
+# Chunked prefill through the ragged step (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_token_parity_across_chunk_sizes(self, engine_factory):
+        """The chunk schedule must never change WHAT is generated — only
+        when prefill finishes. Chunk sizes straddling block boundaries,
+        the prompt length, and 1-token extremes all agree."""
+        ps = prompts(5, np.random.default_rng(11), lo=6, hi=20)
+        outs = []
+        for chunk in (1, 3, 4, 7, 64):
+            fe = ServingFrontend(engine_factory(),
+                                 prefill_chunk_tokens=chunk)
+            hs = [fe.submit(p, max_new_tokens=6) for p in ps]
+            fe.run_until_idle(max_steps=2000)
+            assert all(h.status is RequestStatus.FINISHED for h in hs), \
+                chunk
+            outs.append([h.tokens for h in hs])
+            ServingMetrics.reset_monitor()
+        assert all(o == outs[0] for o in outs[1:])
+
+    def test_long_prompt_does_not_block_decode_lanes(self):
+        """While a long prompt prefills chunk-by-chunk, decode lanes keep
+        committing a token EVERY step — the TPOT-isolation contract."""
+        eng = make_mlp_engine(max_batch=4, num_blocks=64,
+                              max_blocks_per_seq=16)
+        fe = ServingFrontend(eng, prefill_chunk_tokens=4)
+        short = [fe.submit([1, 2, 3], max_new_tokens=40) for _ in range(2)]
+        for _ in range(5):                  # short ones admitted + decoding
+            fe.step()
+        n0 = [len(h.tokens) for h in short]
+        long = fe.submit(list(range(1, 41)), max_new_tokens=4)
+        steps_while_prefilling = 0
+        for _ in range(200):
+            if not long._req.prefilling and long._req._prefill_ctx.size:
+                break
+            fe.step()
+            steps_while_prefilling += 1
+        assert steps_while_prefilling >= 40 // 4
+        n1 = [len(h.tokens) for h in short]
+        # every step during the 10-chunk prefill produced a decode token
+        # on each live short lane (they may finish mid-way: cap at 40)
+        for a, b in zip(n0, n1):
+            assert b == min(40, a + steps_while_prefilling)
+        assert monitor.get("serving.step_prefill_tokens") >= 1
+        fe.run_until_idle(max_steps=200)
+        assert long.status is RequestStatus.FINISHED
+        assert all(h.status is RequestStatus.FINISHED for h in short)
+
+    def test_one_steady_state_executable_across_prompt_lengths(self,
+                                                               engine_factory):
+        """The bucket executable family collapses to ONE: serving prompt
+        lengths from 1 token to several chunks retraces the ragged step
+        exactly once (the first trace), and the PR 7 retrace-cause trace
+        records zero prompt-length-shaped serving retraces."""
+        import paddle_tpu.observability as obs
+
+        obs.enable()
+        try:
+            monitor.reset("serving.ragged_retraces")
+            monitor.reset("serving.decode_retraces")
+            fe = ServingFrontend(engine_factory(), prefill_chunk_tokens=8)
+            rng = np.random.default_rng(5)
+            for n in (1, 2, 5, 9, 14, 23, 31):
+                h = fe.submit(rng.integers(1, VOCAB, n).tolist(),
+                              max_new_tokens=3)
+                fe.run_until_idle(max_steps=300)
+                assert h.status is RequestStatus.FINISHED
+            assert monitor.get("serving.ragged_retraces") == 1
+            assert monitor.get("serving.decode_retraces") == 1
+            assert not [c for c in obs.retrace_causes()
+                        if c["name"].startswith("serve.")]
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_batch_composition_gauges_published(self):
+        fe = ServingFrontend(make_mlp_engine(), prefill_chunk_tokens=4)
+        fe.submit(list(range(1, 11)), max_new_tokens=2)
+        fe.step()                        # first chunk round: 4 tokens
+        assert monitor.get("serving.step_prefill_tokens") == 4
+        assert monitor.get("serving.step_decode_lanes") == 0
+        fe.run_until_idle(max_steps=100)
+        fe.submit([1, 2], max_new_tokens=3)
+        fe.step()                        # 2-token chunk, no decode lane
+        fe.step()                        # pure decode round
+        assert monitor.get("serving.step_prefill_tokens") == 0
+        assert monitor.get("serving.step_decode_lanes") == 1
+
+    def test_spec_equals_plain_under_chunking(self, engine_factory):
+        """spec==plain token parity with prompts longer than the chunk —
+        prefill chunks riding the fixed verify window must not disturb
+        the draft/accept stream (greedy AND stochastic)."""
+        ps = [list(range(1, 18)), ([3, 4, 5] * 7)[:20], [7, 8] * 8]
+        for temp in (0.0, 0.8):
+            outs = []
+            for spec in (None, SpecDecodeConfig(NGramProposer(),
+                                                num_draft_tokens=3)):
+                fe = ServingFrontend(engine_factory(), spec=spec,
+                                     prefill_chunk_tokens=5)
+                hs = [fe.submit(p, max_new_tokens=8, temperature=temp,
+                                seed=9) for p in ps]
+                fe.run_until_idle(max_steps=2000)
+                assert all(h.status is RequestStatus.FINISHED for h in hs)
+                outs.append([h.tokens for h in hs])
+                ServingMetrics.reset_monitor()
+            assert outs[0] == outs[1], f"temperature={temp}"
+
+    def test_llama_long_prompt_chunked_matches_generate(self, llama_model):
+        """End-to-end fidelity with a prompt several chunks long: the
+        chunked serving path reproduces `generate()`'s tokens."""
+        from paddle_tpu.inference import GenerationConfig, \
+            LlamaInferenceEngine
+
+        rng = np.random.default_rng(2)
+        p = rng.integers(1, VOCAB, 23).tolist()
+        eng = LlamaInferenceEngine(llama_model, max_batch_size=1,
+                                   num_blocks=32, block_size=4,
+                                   max_blocks_per_seq=8)
+        ref = eng.generate(np.asarray([p], np.int32),
+                           GenerationConfig(max_new_tokens=5))[0, 23:]
+        eng2 = LlamaInferenceEngine(llama_model, max_batch_size=2,
+                                    num_blocks=32, block_size=4,
+                                    max_blocks_per_seq=8)
+        fe = ServingFrontend(eng2, prefill_chunk_tokens=6)
+        h = fe.submit(p, max_new_tokens=5)
+        fe.run_until_idle(max_steps=200)
+        assert h.tokens == ref.tolist()
+
+
+# ---------------------------------------------------------------------------
 # Llama serving == Llama generate() (numeric fidelity of the serving path)
 # ---------------------------------------------------------------------------
 

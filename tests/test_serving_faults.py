@@ -236,20 +236,31 @@ class TestFaultIsolation:
         fe = ServingFrontend(make_engine(), spec=spec)
         return [h.tokens for h in run_trace(fe, plist, max_new=max_new)]
 
-    def test_prefill_fault_fails_only_that_request(self):
-        plist = prompts(5)
+    def test_prefill_chunk_fault_fails_only_that_request(self):
+        """A fault attributed to a lane that is MID-chunked-prefill fails
+        only that request — prefill now shares the ragged dispatch with
+        the decode lanes, so isolation must hold inside ONE dispatch:
+        the decoding survivors roll back, replay, and stay bitwise
+        identical to a fault-free run."""
+        plist = prompts(3)
         clean = self._clean_tokens(plist)
-        faults.inject("serve.prefill", after_n=2, times=1)
-        fe = ServingFrontend(make_engine())
-        hs = run_trace(fe, plist)
-        failed = [h for h in hs if h.status is RequestStatus.FAILED]
-        assert len(failed) == 1
-        assert failed[0].finish_reason == "engine_fault:prefill"
+        fe = ServingFrontend(make_engine(), prefill_chunk_tokens=4)
+        hs = [fe.submit(p, max_new_tokens=6) for p in plist]
+        for _ in range(4):                 # everyone admitted + decoding
+            fe.step()
+        victim = fe.submit(list(range(1, 17)), max_new_tokens=6)
+        faults.inject("serve.decode", after_n=1, times=1,
+                      exc=EngineStepError("decode",
+                                          seq_ids=[victim.request_id]))
+        fe.run_until_idle(max_steps=500)
+        assert victim.status is RequestStatus.FAILED
+        assert victim.finish_reason == "engine_fault:decode"
+        assert victim.tokens == []         # failed before its 1st token
         for h, ref in zip(hs, clean):
-            if h.status is RequestStatus.FINISHED:
-                assert h.tokens == ref
+            assert h.status is RequestStatus.FINISHED
+            assert h.tokens == ref
         assert monitor.get("serving.isolated_faults") == 1
-        assert monitor.get("serving.isolated_faults.prefill") == 1
+        assert monitor.get("serving.isolated_faults.decode") == 1
         assert_no_leaks(fe)
 
     def test_nan_decode_lane_isolated_survivors_bitwise(self):
@@ -315,7 +326,7 @@ class TestFaultIsolation:
             def __getattr__(self, name):
                 return getattr(inner, name)
 
-            def decode_step(self, tokens, lens, tables):
+            def ragged_step(self, tokens, q_lens, kv_lens, tables):
                 if self.victim is not None:
                     try:
                         vrow = inner.manager.block_table_array(
@@ -326,7 +337,7 @@ class TestFaultIsolation:
                             int(r[0]) == int(vrow[0])
                             for r in np.asarray(tables)):
                         raise RuntimeError("victim lane poisons the step")
-                return inner.decode_step(tokens, lens, tables)
+                return inner.ragged_step(tokens, q_lens, kv_lens, tables)
 
         eng = VictimEngine()
         fe = ServingFrontend(eng)

@@ -9,12 +9,13 @@ each time:
   4. greedy token parity: every request the fault did NOT fail is
      bitwise identical to the fault-free reference run.
 
-Sites driven: `serve.prefill`, `serve.decode` (transient raise, NaN
-flag, targeted `EngineStepError`), `serve.verify` (NaN flag on the
-speculative path; its transient shape shares the decode handler and is
-unit-tested), `serve.sample`, `serve.cache` — plus a persistent-fault
-run that exhausts the restart budget and must fail everything TYPED
-rather than hang.
+Sites driven: `serve.decode` (transient raise, NaN flag, targeted
+`EngineStepError` — against both a decoding and a MID-CHUNKED-PREFILL
+request, since prefill now rides the same ragged dispatch),
+`serve.verify` (NaN flag on the speculative path; its transient shape
+shares the decode handler and is unit-tested), `serve.sample`,
+`serve.cache` — plus a persistent-fault run that exhausts the restart
+budget and must fail everything TYPED rather than hang.
 
 All injection is counted-call arithmetic (`resilience.faults`): no
 clocks, no randomness, no sleeps. Tier-1-safe: MLP engine, < 15 s CPU.
@@ -156,9 +157,14 @@ def main():
         "speculative reference diverged from plain decode"
 
     scenarios = [
-        ("serve.prefill:raise",
-         lambda hs: faults.inject("serve.prefill", after_n=2, times=1),
-         dict(expect_failed=["engine_fault:prefill"])),
+        ("serve.decode:prefill_chunk_targeted",
+         # fires on the FIRST ragged dispatch, while hs[0] is still
+         # prefilling: a fault attributed to a mid-prefill lane fails
+         # only it, before its first token
+         lambda hs: faults.inject(
+             "serve.decode", after_n=0, times=1,
+             exc=EngineStepError("decode", seq_ids=[hs[0].request_id])),
+         dict(expect_failed=["engine_fault:decode"])),
         ("serve.decode:transient",
          lambda hs: faults.inject("serve.decode", after_n=2, times=1),
          dict(expect_failed=[])),
