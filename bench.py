@@ -1103,6 +1103,169 @@ def serving_mixed_main():
     }, "serving_mixed")
 
 
+@scenario("serving_fleet", 420)
+def serving_fleet_main():
+    """`python bench.py serving_fleet` — the multi-replica ROUTER scaling
+    instrument (ROADMAP item 5 / fleet serving): aggregate tok/s and p99
+    TTFT for the same request burst served by 1, 2, and 4 `FleetRouter`
+    replicas, with the scaling ratios as the gated contract.
+
+    What it measures: the fleet CONTROL PLANE. Each replica's engine
+    carries a simulated per-dispatch device-latency floor
+    (`BENCH_FLEET_STEP_LATENCY_MS`, GIL-released, emulating the
+    accelerator wall a real per-chip replica spends its step in), so a
+    2-core CI box measures what production cares about — whether the
+    router's placement, membership, and drain bookkeeping serialize
+    replica progress. Near-linear scaling (>=1.7x at 2, >=3x at 4)
+    holds only while the router's per-step host work stays a small
+    fraction of the replica step; a regression here means fleet
+    dispatch got heavier, exactly what the gate should catch.
+
+    Run SOLO, outside the tier-1 window (the 870 s box truncates).
+    """
+    probe = _scenario_setup("serving_fleet")
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.serving import (FleetRouter, MLPLMEngine,
+                                    RequestStatus, ServingMetrics)
+
+    lat_ms = float(os.environ.get("BENCH_FLEET_STEP_LATENCY_MS", "100"))
+    n_req = int(os.environ.get("BENCH_FLEET_REQUESTS", "64"))
+    max_new = int(os.environ.get("BENCH_FLEET_MAX_NEW", "8"))
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_FLEET_REPLICAS", "1,2,4").split(",")]
+    min_scale = {2: float(os.environ.get("BENCH_FLEET_MIN_SCALE_2X", "1.7")),
+                 4: float(os.environ.get("BENCH_FLEET_MIN_SCALE_4X", "3.0"))}
+
+    class _DeviceLatencyEngine:
+        """MLP engine whose ragged dispatch takes a FIXED wall time:
+        compute runs for real (synced), then a deadline-corrected sleep
+        (GIL-released) tops the dispatch up to `latency_s` — the
+        fixed-shape-executable timing profile of a real accelerator
+        step. Replica "device time" therefore overlaps across threads
+        exactly the way per-chip replicas overlap, and compute/dispatch
+        jitter is absorbed into the floor instead of compounding with
+        thread-scheduler noise."""
+
+        def __init__(self, inner, latency_s):
+            self._inner = inner
+            self._lat = latency_s
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def ragged_step(self, *args):
+            t0 = time.perf_counter()
+            out = self._inner.ragged_step(*args)
+            jax.block_until_ready(out)
+            time.sleep(max(0.0, self._lat
+                           - (time.perf_counter() - t0)))
+            return out
+
+        def respawn(self):
+            return _DeviceLatencyEngine(self._inner.respawn(), self._lat)
+
+    def factory():
+        return _DeviceLatencyEngine(
+            MLPLMEngine(vocab_size=256, hidden=32, max_batch_size=8,
+                        num_blocks=160, block_size=4, max_blocks_per_seq=8,
+                        seed=0), lat_ms / 1e3)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 256, int(rng.integers(4, 10))).tolist()
+               for _ in range(n_req)]
+
+    trials = int(os.environ.get("BENCH_FLEET_TRIALS", "3"))
+
+    def burst(router, n):
+        """One measured burst on a warm router; returns the trial dict."""
+        hs = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        steps = router.run_until_idle()
+        wall = time.perf_counter() - t0
+        bad = [h for h in hs if h.status is not RequestStatus.FINISHED]
+        assert not bad, f"fleet[{n}]: non-finished requests {bad[:3]}"
+        fs = router.fleet_summary()
+        assert fs["counters"].get("fleet.replica_deaths", 0) == 0 \
+            and fs["counters"].get("fleet.relocations", 0) == 0, \
+            f"fleet[{n}]: clean run saw deaths/relocations {fs}"
+        toks = sum(len(h.tokens) for h in hs)
+        ttfts = [h.ttft_ms() for h in hs if h.ttft_ms() is not None]
+        return {
+            "replicas": n,
+            "tok_s": round(toks / wall, 1),
+            "wall_s": round(wall, 2),
+            "steps": steps,
+            "tokens": toks,
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 1),
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 1),
+            "straggler_spread_pct": fs["step_wall_spread_pct"],
+        }
+
+    # PAIRED trials (the PR 6 overload-bench convention): each trial
+    # measures EVERY replica count back-to-back on pre-warmed routers,
+    # so a slow-box epoch hits the trial's baseline and its fleet runs
+    # alike and cancels out of the ratio; the gated scaling is the
+    # MEDIAN paired ratio. Unpaired best-of-N still let a lucky
+    # 1-replica trial divide an unlucky 4-replica trial (observed ±10%
+    # interference on a contended 2-core box -> spurious ratio misses).
+    ServingMetrics.reset_monitor()
+    monitor.reset_prefix("fleet.")
+    routers = {}
+    try:
+        for n in counts:
+            # relaxed membership cadence: at a 100 ms step, the default
+            # heartbeat-every-8-steps file lock/write lands mid-burst
+            # often enough for a slow disk to show up in the walls
+            router = FleetRouter(factory, num_replicas=n, parallel=True,
+                                 heartbeat_every=64, sweep_every=512)
+            routers[n] = router
+            for p in prompts[:2 * n]:   # warm executables + step pool
+                router.submit(p, max_new_tokens=2)
+            router.run_until_idle()
+        trial_runs = [{n: burst(routers[n], n) for n in counts}
+                      for _ in range(trials)]
+    finally:
+        for router in routers.values():
+            router.close()
+    ratios = {n: sorted(t[n]["tok_s"] / t[counts[0]]["tok_s"]
+                        for t in trial_runs) for n in counts}
+    scaling = {n: round(ratios[n][len(ratios[n]) // 2], 2)
+               for n in counts}        # median paired ratio
+    # per-count report: the best trial (capability), scaling from pairs
+    runs = {n: max((t[n] for t in trial_runs),
+                   key=lambda r: r["tok_s"]) for n in counts}
+    for n, bar in min_scale.items():
+        if n in runs:
+            assert scaling[n] >= bar, \
+                f"fleet scaling at {n} replicas {scaling[n]}x < {bar}x " \
+                f"(paired-trial median; router host work is " \
+                f"serializing replica steps)"
+    top = max(counts)
+    extras = {
+        "runs": {str(n): runs[n] for n in counts},
+        "scaling_2x": scaling.get(2),
+        "scaling_4x": scaling.get(4),
+        "ttft_p99_ms": runs[top]["ttft_p99_ms"],
+        "simulated_step_latency_ms": lat_ms,
+        "requests": n_req,
+        "probe": probe,
+        "device": jax.devices()[0].device_kind or "cpu",
+    }
+    _emit_report({
+        "metric": "serving_fleet_tok_s",
+        "value": runs[top]["tok_s"],
+        "unit": f"fleet tok/s at {top} replicas "
+                f"(scaling 1->{top}: {scaling[top]}x, "
+                f"p99 TTFT {runs[top]['ttft_p99_ms']} ms, "
+                f"{lat_ms} ms simulated device step)",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "serving_fleet")
+
+
 @scenario("kernel_micro", 300)
 def kernel_micro_main():
     """`python bench.py kernel_micro` — paged-attention kernel microbench

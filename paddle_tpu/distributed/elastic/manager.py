@@ -12,7 +12,7 @@ load, `distributed/checkpoint/`).
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from .store import MembershipStore
 
@@ -21,7 +21,12 @@ __all__ = ["ElasticManager"]
 
 class ElasticManager:
     def __init__(self, store: MembershipStore, min_nodes: int,
-                 max_nodes: int, stabilize_s: float = 1.0):
+                 max_nodes: int, stabilize_s: float = 1.0,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep):
+        """``clock``/``sleep`` are injectable (the `framework/retry.py`
+        pattern) so membership tests — and the fleet router's — drive
+        `wait_for_world` deterministically with zero real sleeps."""
         if min_nodes < 1 or max_nodes < min_nodes:
             raise ValueError(
                 f"invalid elastic range [{min_nodes}, {max_nodes}]")
@@ -29,21 +34,34 @@ class ElasticManager:
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.stabilize_s = float(stabilize_s)
+        self._clock = clock
+        self._sleep = sleep
 
     # -- membership ---------------------------------------------------------
-    def register(self, pod_id: str, endpoint: str = "") -> None:
-        self.store.register(pod_id, endpoint)
+    def register(self, pod_id: str, endpoint: str = "",
+                 payload: Optional[dict] = None) -> int:
+        """Register (or re-register) a pod; returns its incarnation
+        epoch — pass it back on every heartbeat so a dead predecessor's
+        beats cannot refresh this registration."""
+        return self.store.register(pod_id, endpoint, payload=payload)
 
-    def heartbeat(self, pod_id: str) -> None:
-        self.store.heartbeat(pod_id)
+    def heartbeat(self, pod_id: str, incarnation: Optional[int] = None,
+                  payload: Optional[dict] = None) -> bool:
+        return self.store.heartbeat(pod_id, incarnation=incarnation,
+                                    payload=payload)
 
-    def heartbeat_many(self, pod_ids) -> None:
-        self.store.heartbeat_many(pod_ids)
+    def heartbeat_many(self, pod_ids, incarnations=None,
+                       payloads=None) -> List[str]:
+        return self.store.heartbeat_many(pod_ids, incarnations=incarnations,
+                                         payloads=payloads)
 
-    def report_dead(self, pod_id: str) -> None:
+    def report_dead(self, pod_id: str,
+                    incarnation: Optional[int] = None) -> None:
         """Fault detection input (reference :410 watch): the launcher saw
-        this pod's process die."""
-        self.store.deregister(pod_id)
+        this pod's process die. Pass the dead pod's ``incarnation`` to
+        fence the removal — a successor that already re-registered under
+        the same id must not lose its live lease."""
+        self.store.deregister(pod_id, incarnation=incarnation)
 
     def reap_stale(self, timeout_s: Optional[float] = None,
                    now: Optional[float] = None) -> List[str]:
@@ -71,16 +89,18 @@ class ElasticManager:
         """Block until membership yields a trainable world (>= min_nodes),
         letting it stabilize so simultaneous joins/leaves coalesce into one
         restart (reference :457). Returns the rank-ordered pod ids, or
-        None if the deadline passes below min_nodes."""
-        end = time.time() + deadline_s
-        while time.time() < end:
+        None if the deadline passes below min_nodes. Time flows only
+        through the injected ``clock``/``sleep``, so membership tests
+        drive the full wait loop with zero real sleeps."""
+        end = self._clock() + deadline_s
+        while self._clock() < end:
             pods = self.ranks()
             if len(pods) >= self.min_nodes:
-                time.sleep(self.stabilize_s)  # coalesce concurrent changes
+                self._sleep(self.stabilize_s)  # coalesce concurrent changes
                 again = self.ranks()
                 if len(again) >= self.min_nodes:
                     return again
-            time.sleep(0.2)
+            self._sleep(0.2)
         return None
 
     def scale_changed(self, current: List[str]) -> Tuple[bool, List[str]]:

@@ -59,32 +59,102 @@ class MembershipStore:
             finally:
                 fcntl.flock(lk, fcntl.LOCK_UN)
 
-    def register(self, pod_id: str, endpoint: str = "") -> None:
-        """Announce a pod (reference `_host_to_etcd` registration)."""
+    def register(self, pod_id: str, endpoint: str = "",
+                 payload: Optional[dict] = None) -> int:
+        """Announce a pod (reference `_host_to_etcd` registration) and
+        return its **incarnation epoch** — a per-pod-id counter that
+        bumps on every registration. A re-register under the same id
+        (restart, replacement replica) therefore yields a HIGHER
+        incarnation than the entry it replaced, and heartbeats carrying
+        the dead predecessor's incarnation are ignored (see
+        :meth:`heartbeat_many`) — a zombie can no longer silently revive
+        or refresh its successor's lease. ``payload`` is an arbitrary
+        JSON-able load report stored alongside the lease (the fleet
+        router publishes queue depth / queued cost / KV utilization)."""
 
         def mutate(pods):
+            prev = pods.get(pod_id) or {}
+            incarnation = int(prev.get("incarnation", 0)) + 1
             pods[pod_id] = {"endpoint": endpoint,
-                            "last_heartbeat": time.time()}
+                            "last_heartbeat": time.time(),
+                            "incarnation": incarnation}
+            if payload is not None:
+                pods[pod_id]["payload"] = payload
+            return incarnation
 
-        self._locked(mutate)
+        return self._locked(mutate)
 
-    def heartbeat(self, pod_id: str) -> None:
-        self.heartbeat_many([pod_id])
+    def heartbeat(self, pod_id: str, incarnation: Optional[int] = None,
+                  payload: Optional[dict] = None) -> bool:
+        """Renew one lease; True iff applied (False = stale incarnation
+        or unknown pod)."""
+        stale = self.heartbeat_many(
+            [pod_id],
+            None if incarnation is None else {pod_id: incarnation},
+            None if payload is None else {pod_id: payload})
+        return pod_id not in stale
 
-    def heartbeat_many(self, pod_ids) -> None:
+    def heartbeat_many(self, pod_ids,
+                       incarnations: Optional[Dict[str, int]] = None,
+                       payloads: Optional[Dict[str, dict]] = None
+                       ) -> List[str]:
         """Renew several leases under ONE lock/write cycle (the launcher
-        heartbeats every local pod each poll tick)."""
+        heartbeats every local pod each poll tick). ``incarnations``
+        guards against zombies: a heartbeat whose incarnation does not
+        match the registered entry's is REJECTED — it came from a dead
+        pod's previous life, and applying it would keep its successor's
+        entry alive on the zombie's schedule (or resurrect a reaped
+        lease). A pod id absent from ``incarnations`` heartbeats
+        unguarded (legacy single-incarnation launchers). ``payloads``
+        refreshes the per-pod load report in the same write. Returns the
+        pod ids whose heartbeat was rejected as stale (also counted on
+        the ``elastic.stale_heartbeats`` monitor counter)."""
         now = time.time()
 
         def mutate(pods):
+            stale = []
             for pid in pod_ids:
-                if pid in pods:
-                    pods[pid]["last_heartbeat"] = now
+                entry = pods.get(pid)
+                want = (incarnations or {}).get(pid)
+                if entry is None:
+                    if want is not None:
+                        stale.append(pid)   # reaped/deregistered: a
+                    continue                # guarded beat must NOT revive
+                if want is not None \
+                        and int(entry.get("incarnation", 0)) != int(want):
+                    stale.append(pid)
+                    continue
+                entry["last_heartbeat"] = now
+                if payloads and pid in payloads:
+                    entry["payload"] = payloads[pid]
+            return stale
 
-        self._locked(mutate)
+        stale = self._locked(mutate)
+        if stale:
+            from ...framework import monitor
 
-    def deregister(self, pod_id: str) -> None:
-        self._locked(lambda pods: pods.pop(pod_id, None))
+            monitor.inc("elastic.stale_heartbeats", len(stale))
+        return stale
+
+    def deregister(self, pod_id: str,
+                   incarnation: Optional[int] = None) -> bool:
+        """Remove a pod's registration; True iff an entry was removed.
+        With ``incarnation``, the removal is fenced: it only applies to
+        that exact incarnation — a fenced/zombie pod deregistering
+        itself cannot delete the successor that superseded its lease.
+        ``None`` removes unconditionally (operator action)."""
+
+        def mutate(pods):
+            entry = pods.get(pod_id)
+            if entry is None:
+                return False
+            if incarnation is not None \
+                    and int(entry.get("incarnation", 0)) != int(incarnation):
+                return False
+            del pods[pod_id]
+            return True
+
+        return self._locked(mutate)
 
     def reap_stale(self, timeout_s: float,
                    now: Optional[float] = None) -> List[str]:

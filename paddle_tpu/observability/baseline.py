@@ -44,6 +44,13 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
     "serving_mixed": [("value", "higher"),
                       ("extras.tpot_p99_during_prefill_ms", "lower")],
     "kernel_micro": [("value", "higher")],
+    # fleet-router scaling (ROADMAP item 5): aggregate throughput at the
+    # top replica count, the 1->4 scaling ratio (the router-overhead
+    # contract — near-linear or the control plane is serializing
+    # replicas), and tail TTFT under the burst
+    "serving_fleet": [("value", "higher"),
+                      ("extras.scaling_4x", "higher"),
+                      ("extras.ttft_p99_ms", "lower")],
     # distributed observability dryrun: host-exposed comm must not grow,
     # traced bandwidth must not collapse, and the GSPMD step's comm
     # VOLUME (deterministic — from the compiled HLO, so it keeps the
@@ -66,6 +73,11 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
 # metric keeps its tight per-metric override above.
 SCENARIO_GATE_PCT: Dict[str, float] = {
     "dryrun_multichip": 30.0,
+    # best-of-N sleep-floored walls still move ~±10% peak-to-trough on a
+    # contended 2-core box (thread-scheduler interference), and the
+    # last-good ratchet pins the baseline to the luckiest run ever seen;
+    # the in-run scaling asserts (>=1.7x/3x) are the hard contract
+    "serving_fleet": 25.0,
 }
 
 

@@ -392,6 +392,7 @@ class Profiler:
                     f"{k}={v}" for k, v in counters.items()))
         lines.extend(self._lazy_summary_lines())
         lines.extend(self._serving_summary_lines())
+        lines.extend(self._fleet_summary_lines())
         lines.extend(self._resilience_summary_lines())
         lines.extend(self._observability_summary_lines())
         lines.extend(self._mesh_summary_lines())
@@ -529,6 +530,42 @@ class Profiler:
                 f"{g('serving.stall_detections')} stall detections")
             if shed_by:
                 lines.append("  shed reasons: " + cls._kv_join(shed_by))
+        return lines
+
+    @classmethod
+    def _fleet_summary_lines(cls):
+        """Multi-replica serving-fleet stats (`serving/fleet.py`):
+        replica population, relocation/death/drain activity, placement
+        failover, and session-affinity effectiveness. Empty unless a
+        `FleetRouter` ran in this process."""
+        from ..framework import monitor
+
+        snap = monitor.snapshot("fleet.", include_histograms=False)
+        g = lambda k: snap.get(k, 0)  # noqa: E731
+        if not g("fleet.replicas_total"):
+            return []
+        lines = [
+            "",
+            f"Fleet: {g('fleet.replicas_alive')}/"
+            f"{g('fleet.replicas_total')} replicas alive "
+            f"({g('fleet.replicas_draining')} draining, "
+            f"{g('fleet.replicas_added')} added, "
+            f"{g('fleet.drained')} drained, "
+            f"{g('fleet.replica_deaths')} deaths), "
+            f"{g('fleet.submitted')} fleet submissions",
+            f"  relocations {g('fleet.relocations')} "
+            f"({g('fleet.relocated_tokens')} tokens carried), "
+            f"retried submits {g('fleet.retried_submits')}, "
+            f"submit faults {g('fleet.submit_faults')}, "
+            f"fleet-failed {g('fleet.requests_failed')}",
+        ]
+        if g("fleet.session_hits") or g("fleet.session_misses"):
+            lines.append(
+                f"  session affinity: {g('fleet.session_hits')} hits / "
+                f"{g('fleet.session_misses')} misses")
+        failed = cls._reason_counts(snap, "fleet.requests_failed.")
+        if failed:
+            lines.append("  fleet failure reasons: " + cls._kv_join(failed))
         return lines
 
     @staticmethod

@@ -35,6 +35,13 @@ scheduler to poison one lane's logits with NaN instead of raising),
 `serving.EngineStepError` with ``seq_ids`` drives the targeted
 lane-isolation path; the default `InjectedIOError` drives the
 transient-retry path. See docs/SERVING.md "Failure semantics".
+
+Fleet sites (`serving/fleet.py`): ``fleet.step`` (per `FleetRouter`
+step; ``action="flag"`` chaos-kills the busiest live replica — the
+mid-burst replica-kill the fleet chaos smoke drives) and
+``fleet.submit`` (per placement attempt; a raise models an unreachable
+replica and exercises submit failover). See docs/SERVING.md "Fleet
+routing & replica failure".
 """
 from __future__ import annotations
 

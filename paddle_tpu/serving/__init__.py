@@ -27,10 +27,16 @@ Components:
   shedding, the `EngineStepError` isolation boundary, `WatchdogConfig`
   bounded engine restarts, typed `EngineStalled` — every submitted
   request reaches a terminal status no matter what the engine does.
+- `FleetRouter` (fleet.py): the data-parallel replica tier — N
+  frontends behind load-aware session-affine dispatch, elastic
+  membership with incarnation-fenced heartbeats, and replica-failure
+  relocation that carries committed tokens as prompt prefix, extending
+  the terminal-status contract fleet-wide.
 """
 from .engine import EngineCore, MLPLMEngine
 from .fault_tolerance import (AdmissionConfig, EngineStalled,
                               EngineStepError, WatchdogConfig)
+from .fleet import FleetHandle, FleetRouter, ReplicaHandle
 from .frontend import RequestHandle, ServingFrontend
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
@@ -39,8 +45,9 @@ from .spec import (DraftEngineProposer, NGramProposer, Proposer,
 
 __all__ = [
     "AdmissionConfig", "DraftEngineProposer", "EngineCore", "EngineStalled",
-    "EngineStepError", "MLPLMEngine", "NGramProposer", "Proposer",
-    "Request", "RequestHandle", "RequestStatus", "SamplingParams",
-    "Scheduler", "ServingFrontend", "ServingMetrics", "SpecDecodeConfig",
+    "EngineStepError", "FleetHandle", "FleetRouter", "MLPLMEngine",
+    "NGramProposer", "Proposer", "ReplicaHandle", "Request",
+    "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
+    "ServingFrontend", "ServingMetrics", "SpecDecodeConfig",
     "WatchdogConfig",
 ]

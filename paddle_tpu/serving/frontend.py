@@ -61,6 +61,20 @@ class RequestHandle:
     def num_preemptions(self) -> int:
         return self._req.num_preemptions
 
+    @property
+    def replica_id(self):
+        """Replica currently serving this request (`serving/fleet.py`
+        placement); None under a standalone frontend."""
+        return self._req.replica_id
+
+    @property
+    def num_relocations(self) -> int:
+        """How many times a replica failure or drain moved this request
+        to another replica (committed tokens carried as prompt prefix);
+        each move also lands a `relocated` event on the request's
+        timeline."""
+        return self._req.num_relocations
+
     def timeline(self) -> list:
         """This request's recorded observability events (oldest first),
         as dicts — empty unless `observability.enable()` was on while it
@@ -154,6 +168,30 @@ class ServingFrontend:
 
     def cancel(self, handle: RequestHandle) -> bool:
         return self.scheduler.cancel(handle._req)
+
+    # ---- fleet hooks (serving/fleet.py) ----
+    def in_flight(self) -> List[Request]:
+        """Non-terminal requests this frontend owns (admission order
+        then queue) — what a drain or replica-failure relocation must
+        account for."""
+        return self.scheduler.in_flight()
+
+    def release(self, handle_or_req) -> bool:
+        """Take a non-terminal request OUT of this frontend without a
+        terminal status (blocks freed, tokens-so-far kept, status
+        PREEMPTED) so a router can re-submit it elsewhere. Accepts a
+        `RequestHandle` or a raw `Request`."""
+        req = getattr(handle_or_req, "_req", handle_or_req)
+        return self.scheduler.release(req)
+
+    def resubmit(self, req: Request) -> Request:
+        """Route an existing `Request` object through this frontend's
+        admission (the relocation path — `submit()` builds fresh
+        requests). The caller must have reset the request to QUEUED with
+        its committed tokens folded into the prompt; admission may still
+        reject/shed it (terminal status on return, never an
+        exception)."""
+        return self.scheduler.submit(req, now=self._clock())
 
     # ---- driving ----
     def step(self) -> int:

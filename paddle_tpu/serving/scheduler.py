@@ -133,6 +133,13 @@ class Request:
         self.status = RequestStatus.QUEUED
         self.finish_reason: Optional[str] = None
         self.num_preemptions = 0
+        # fleet placement (serving/fleet.py): which replica currently
+        # serves this request, and how many times a replica failure or
+        # drain moved it (committed tokens carried as prompt prefix).
+        # None/0 for a request served by a standalone frontend.
+        self.replica_id: Optional[str] = None
+        self.num_relocations = 0
+        self.session_id: Optional[str] = None
         self.t_submit: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_finish: Optional[float] = None
@@ -244,6 +251,10 @@ class Scheduler:
         self._tpot_samples: Deque[float] = deque(maxlen=32)
         self._zero_progress = 0        # consecutive no-progress steps
         self._finish_events = 0        # terminal transitions, monotonic
+        self.tokens_committed = 0      # tokens committed to request
+        # streams over this scheduler's lifetime (decode + prefill first
+        # tokens + speculative accepts) — the per-replica throughput
+        # figure fleet aggregation reads (monitor counters are global)
         self._step_faults = 0          # consecutive unattributed faults
         self._pending_stall: Optional[str] = None
         self._broken: Optional[str] = None   # rebind failed mid-restart
@@ -354,6 +365,40 @@ class Scheduler:
             self._obs_req(req, "terminal:shed", t0=req.t_finish,
                           reason=reason)
         return req
+
+    def in_flight(self) -> List[Request]:
+        """Every non-terminal request this scheduler owns, admission
+        order first (running slots by admit sequence) then the waiting
+        queue — the export surface a fleet router drains or relocates
+        from."""
+        running = sorted(((r._admit_seq, i) for i, r in
+                          enumerate(self.slots) if r is not None))
+        return [self.slots[i] for _, i in running] + list(self.waiting)
+
+    def release(self, req: Request) -> bool:
+        """Remove a non-terminal request from this scheduler WITHOUT
+        assigning a terminal status: its blocks are freed, speculative
+        state dropped, and the request lands in PREEMPTED with its
+        tokens-so-far intact — exactly the preemption invariant, so a
+        re-submission elsewhere (fleet relocation, drain) replays
+        token-deterministically with the committed tokens as prompt
+        prefix. Unlike a preemption it does NOT re-queue here and does
+        not bump preemption counters (a drain is policy, not pressure).
+        Returns False when the request is terminal or not owned here."""
+        if req.status.terminal:
+            return False
+        if req in self.waiting:
+            self._queue_remove(req)
+            req.status = RequestStatus.PREEMPTED
+            return True
+        for i, r in enumerate(self.slots):
+            if r is req:
+                self.slots[i] = None
+                self.engine.manager.free(req.seq_id)
+                self._release_spec(req)
+                req.status = RequestStatus.PREEMPTED
+                return True
+        return False
 
     def cancel(self, req: Request) -> bool:
         if req.status.terminal:
@@ -1318,6 +1363,7 @@ class Scheduler:
         accounting can never diverge between the plain and spec paths."""
         req.generated.append(tok)
         req._last = tok
+        self.tokens_committed += 1
         if req.t_first_token is None:
             req.t_first_token = t_tok
             self.metrics.on_first_token(req)

@@ -77,6 +77,88 @@ class TestElasticManager:
             ElasticManager(st, min_nodes=3, max_nodes=2)
 
 
+class TestIncarnationEpochs:
+    """Stale-heartbeat fencing: a dead pod's previous life cannot revive
+    or refresh its successor's registration (fleet satellite)."""
+
+    def test_register_bumps_incarnation(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        inc1 = st.register("a")
+        inc2 = st.register("a")     # replacement claims the same pod id
+        assert inc2 == inc1 + 1
+        assert st.alive()["a"]["incarnation"] == inc2
+
+    def test_stale_heartbeat_rejected(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        inc1 = st.register("a")
+        inc2 = st.register("a")                    # successor
+        assert st.heartbeat("a", incarnation=inc1) is False  # zombie
+        assert st.heartbeat("a", incarnation=inc2) is True
+        stale = st.heartbeat_many(["a"], incarnations={"a": inc1})
+        assert stale == ["a"]
+        from paddle_tpu.framework import monitor
+
+        assert monitor.get("elastic.stale_heartbeats") >= 2
+
+    def test_stale_heartbeat_cannot_revive_reaped_pod(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        inc = st.register("a")
+        reaped = st.reap_stale(0.0, now=time.time() + 100)
+        assert reaped == ["a"]
+        # the zombie's guarded beat must NOT re-create the entry
+        assert st.heartbeat("a", incarnation=inc) is False
+        assert "a" not in st.alive()
+        # an UNguarded legacy beat on an unknown pod is also a no-op
+        st.heartbeat("a")
+        assert "a" not in st.alive()
+
+    def test_fenced_deregister_spares_successor(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        inc1 = st.register("a")
+        inc2 = st.register("a")              # successor claims the id
+        # the fenced old incarnation cannot delete the successor's lease
+        assert st.deregister("a", incarnation=inc1) is False
+        assert st.alive()["a"]["incarnation"] == inc2
+        assert st.deregister("a", incarnation=inc2) is True
+        assert "a" not in st.alive()
+        # unconditional removal (operator) still works
+        st.register("b")
+        assert st.deregister("b") is True
+
+    def test_heartbeat_payload_refresh(self, tmp_path):
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        inc = st.register("a", payload={"queue_depth": 0})
+        st.heartbeat("a", incarnation=inc, payload={"queue_depth": 7})
+        assert st.alive()["a"]["payload"] == {"queue_depth": 7}
+
+    def test_zero_sleep_wait_for_world(self, tmp_path):
+        """`wait_for_world` with injected clock/sleep: the full wait +
+        stabilize loop runs with no real sleeps (fleet satellite —
+        PR 3 `framework/retry.py` pattern)."""
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30)
+        now = [0.0]
+        sleeps = []
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            now[0] += s
+
+        mgr = ElasticManager(st, min_nodes=2, max_nodes=4,
+                             stabilize_s=1.0,
+                             clock=lambda: now[0], sleep=fake_sleep)
+        # below min the loop polls to the deadline and gives up — with
+        # zero wall time passing
+        t0 = time.perf_counter()
+        assert mgr.wait_for_world(deadline_s=30.0) is None
+        assert now[0] >= 30.0 and sleeps.count(0.2) > 100
+        mgr.register("a")
+        mgr.register("b")
+        pods = mgr.wait_for_world(deadline_s=30.0)
+        assert pods == ["a", "b"]
+        assert 1.0 in sleeps            # the stabilize window ran, faked
+        assert time.perf_counter() - t0 < 5.0   # no real sleeping
+
+
 _ELASTIC_WORKER = '''
 import os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -60,6 +60,7 @@ _METRIC_TO_SCENARIO = {
     "serving_throughput": "serving_throughput",
     "serving_throughput_spec": "serving_spec",
     "dryrun_multichip_comms": "dryrun_multichip",
+    "serving_fleet_tok_s": "serving_fleet",
 }
 
 

@@ -17,6 +17,14 @@ shares the decode handler and is unit-tested), `serve.sample`,
 `serve.cache` — plus a persistent-fault run that exhausts the restart
 budget and must fail everything TYPED rather than hang.
 
+Fleet pass (`fleet.step`): the same contract FLEET-WIDE — a replica is
+killed mid-Poisson-burst (the armed `fleet.step` flag fires the chaos
+kill on the busiest replica), and afterwards: every request terminal,
+relocated + survivor GREEDY token streams bitwise equal to the unkilled
+run's (committed-prefix parity: zero lost, zero duplicated tokens),
+relocations within the per-request budget, and `kv_leaked_blocks()==0`
+on every SURVIVOR (the dead replica's pool died with it).
+
 All injection is counted-call arithmetic (`resilience.faults`): no
 clocks, no randomness, no sleeps. Tier-1-safe: MLP engine, < 15 s CPU.
 
@@ -140,6 +148,115 @@ def check_contract(name, fe, handles, reference, expect_failed=None):
     return report
 
 
+def fleet_trace():
+    """Deterministic Poisson-ish burst: step index -> requests arriving
+    then (seeded rng; no clocks, no sleeps)."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, VOCAB, int(rng.integers(3, 10))).tolist()
+               for _ in range(18)]
+    arrivals = []
+    i = 0
+    step = 0
+    while i < len(prompts):
+        k = int(rng.poisson(1.6))
+        for _ in range(min(k, len(prompts) - i)):
+            arrivals.append((step, prompts[i]))
+            i += 1
+        step += 1
+    return arrivals
+
+
+def fleet_run(kill_at_step=None, relocation_budget=2):
+    """Serve the deterministic burst on a 3-replica fleet, optionally
+    arming `fleet.step` to chaos-kill the busiest replica mid-burst.
+    Returns (router, handles)."""
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (FleetRouter, ServingMetrics,
+                                    WatchdogConfig)
+
+    ServingMetrics.reset_monitor()
+    from paddle_tpu.framework import monitor
+
+    monitor.reset_prefix("fleet.")
+    router = FleetRouter(
+        make_engine, num_replicas=3,
+        relocation_budget=relocation_budget,
+        frontend_kwargs=dict(watchdog=WatchdogConfig(
+            step_retries=2, max_restarts=MAX_RESTARTS)))
+    if kill_at_step is not None:
+        faults.inject("fleet.step", after_n=kill_at_step, times=1,
+                      action="flag")
+    handles = []
+    arrivals = fleet_trace()
+    i = 0
+    step = 0
+    while i < len(arrivals) or not router.idle:
+        while i < len(arrivals) and arrivals[i][0] <= step:
+            handles.append(router.submit(arrivals[i][1],
+                                         max_new_tokens=6))
+            i += 1
+        router.step()
+        step += 1
+        assert step < 4000, "fleet burst never drained"
+    faults.clear()
+    return router, handles
+
+
+def fleet_chaos(reference_tokens):
+    """The fleet-wide chaos scenario: kill a replica mid-burst, assert
+    the fleet-wide contract."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.serving import RequestStatus
+
+    router, handles = fleet_run(kill_at_step=4)
+    try:
+        dead = [r for r in router.replicas if not r.alive]
+        survivors = [r for r in router.replicas if r.alive]
+        assert len(dead) == 1 and dead[0].death_reason == "chaos_kill", \
+            f"expected exactly one chaos kill, got {dead}"
+        # 1. nothing lost fleet-wide
+        non_terminal = [h.request_id for h in handles if not h.finished]
+        assert not non_terminal, f"non-terminal after drain {non_terminal}"
+        # 2. greedy token parity vs the unkilled run — for EVERY finished
+        # request, including the relocated ones (committed-prefix parity:
+        # prefix carried + survivor continuation == uninterrupted stream)
+        mismatch = [i for i, (h, ref) in
+                    enumerate(zip(handles, reference_tokens))
+                    if h.status is RequestStatus.FINISHED
+                    and h.tokens != ref]
+        assert not mismatch, f"token parity broke at {mismatch}"
+        relocated = [h for h in handles if h.num_relocations > 0]
+        assert relocated, "the kill relocated nothing — it missed " \
+            "every in-flight request (tune kill_at_step)"
+        # 3. relocation budget respected
+        over = [h.request_id for h in handles
+                if h.num_relocations > router.relocation_budget]
+        assert not over, f"relocation budget exceeded {over}"
+        # 4. zero leaked KV blocks on every survivor
+        for rep in survivors:
+            leaked = rep.scheduler.kv_leaked_blocks()
+            assert leaked == 0, f"{rep.replica_id}: {leaked} leaked"
+        # replica-level restarts stayed within each watchdog's budget
+        restarts = monitor.get("serving.engine_restarts")
+        assert restarts <= MAX_RESTARTS * 3, f"{restarts} restarts"
+        report = {
+            "scenario": "fleet.step:chaos_kill",
+            "requests": len(handles),
+            "finished": sum(h.status is RequestStatus.FINISHED
+                            for h in handles),
+            "killed": dead[0].replica_id,
+            "relocated": len(relocated),
+            "relocations": monitor.get("fleet.relocations"),
+            "relocated_tokens": monitor.get("fleet.relocated_tokens"),
+            "survivor_parity": True,
+            "leaked_blocks": 0,
+        }
+        print(json.dumps(report))
+        return report
+    finally:
+        router.close()
+
+
 def main():
     from paddle_tpu.resilience import faults
     from paddle_tpu.serving import EngineStepError, RequestStatus
@@ -217,12 +334,25 @@ def main():
                     "typed": True})
     print(json.dumps(reports[-1]))
 
+    # fleet-wide pass: unkilled reference, then the mid-burst replica kill
+    faults.clear()
+    ref_router, ref_handles = fleet_run()
+    try:
+        assert all(h.status is RequestStatus.FINISHED for h in ref_handles)
+        assert all(h.num_relocations == 0 for h in ref_handles)
+        fleet_reference = [h.tokens for h in ref_handles]
+    finally:
+        ref_router.close()
+    reports.append(fleet_chaos(fleet_reference))
+
     print(json.dumps({
         "ok": True,
         "scenarios": len(reports),
         "secs": round(time.time() - t0, 1),
         "contract": "all requests terminal, restarts <= budget, "
-                    "0 leaked blocks, survivor greedy parity",
+                    "0 leaked blocks, survivor greedy parity, "
+                    "fleet: replica kill -> relocation parity, "
+                    "relocations <= budget, survivors leak-free",
     }))
 
 
