@@ -1103,6 +1103,175 @@ def serving_mixed_main():
     }, "serving_mixed")
 
 
+@scenario("serving_shared_prefix", 420)
+def serving_shared_prefix_main():
+    """`python bench.py serving_shared_prefix` — the shared-prefix radix
+    caching acceptance instrument (ROADMAP item 1): an 80 %-shared-prefix
+    Poisson trace (the shape of real system-prompt traffic) runs twice on
+    identical stacks — radix cache ON vs OFF — and the cached run must
+    show >3x TTFT p99 on the shared requests and >1.5x aggregate tok/s
+    (prefill work is the dominant cost the cache removes). Also asserted
+    in-run: zero steady-state ragged retraces (block sharing is pure
+    host bookkeeping — the executable never changes), eviction pressure
+    actually exercised (the pool is sized so unique suffixes force LRU
+    eviction of unpinned tree nodes), and zero leaked / double-freed
+    blocks afterwards (`kv_leaked_blocks` + refcount consistency audit
+    including the tree's leases). Run SOLO outside the tier-1 window
+    (ROADMAP note)."""
+    probe = _scenario_setup("serving_shared_prefix")
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.inference import LlamaInferenceEngine
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import (RequestStatus, ServingFrontend,
+                                    ServingMetrics)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    prefix_len = int(os.environ.get("BENCH_PREFIX_LEN", "192"))
+    n_requests = int(os.environ.get("BENCH_PREFIX_REQUESTS", "40"))
+    mean_gap_s = 0.03
+    model = llama_tiny(vocab=128, layers=2, hidden=64, heads=4,
+                       seq=prefix_len + 160)
+    model.eval()
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 128, prefix_len).tolist()
+    # the trace: 80 % shared-prefix + unique suffix, 20 % fully cold
+    specs = []
+    for i in range(n_requests):
+        sfx = rng.integers(8, 17)
+        if rng.random() < 0.8:
+            specs.append((True, shared + rng.integers(
+                1, 128, sfx).tolist()))
+        else:
+            specs.append((False, rng.integers(
+                1, 128, prefix_len + sfx).tolist()))
+    gaps = rng.exponential(mean_gap_s, n_requests)
+    arrivals = np.cumsum(gaps)
+
+    def build_engine():
+        # pool sized so the tree (shared path + unique published
+        # suffixes + the cold requests' full paths) outgrows it over
+        # the trace: LRU eviction pressure is part of the contract
+        return LlamaInferenceEngine(
+            model, max_batch_size=8, block_size=8,
+            num_blocks=int(os.environ.get("BENCH_PREFIX_BLOCKS", "256")),
+            max_blocks_per_seq=(prefix_len + 160) // 8,
+            **({"dtype": "bfloat16"} if on_tpu else {}))
+
+    def run_trace(prefix_cache: bool):
+        ServingMetrics.reset_monitor()
+        fe = ServingFrontend(build_engine(), prefix_cache=prefix_cache,
+                             prefill_chunk_tokens=32)
+        # warmup: compile the ragged step at the packed shape AND seed
+        # the cache with the shared prefix (steady-state serving has the
+        # system prompt resident; the cold 20 % and the unique suffixes
+        # still measure the miss path), then drain
+        for n in (3, 17):
+            fe.submit(rng.integers(1, 128, n).tolist(), max_new_tokens=2)
+        fe.submit(shared, max_new_tokens=2)
+        fe.run_until_idle(max_steps=1000)
+        monitor.reset("serving.ragged_retraces")
+        fe.metrics.reset_window()
+        base_tokens = monitor.get("serving.tokens_generated")
+        tree = fe.scheduler.prefix_cache
+        stats0 = tree.stats() if tree is not None else None
+
+        def submit_one(i):
+            return fe.submit(specs[i][1], max_new_tokens=4)
+
+        handles, wall = _drive_poisson(fe, arrivals, submit_one)
+        done = sum(h.status is RequestStatus.FINISHED for h in handles)
+        tokens = monitor.get("serving.tokens_generated") - base_tokens \
+            + done  # + the prefill-sampled first tokens
+        shared_ttfts = sorted(
+            h.ttft_ms() for (is_shared, _), h in zip(specs, handles)
+            if is_shared and h.ttft_ms() is not None)
+        p99 = lambda xs: round(float(  # noqa: E731
+            np.percentile(np.asarray(xs), 99)), 3)
+        sched = fe.scheduler
+        leaked = sched.kv_leaked_blocks()
+        prefix = None
+        if tree is not None:
+            # double-free / refcount audit with the tree's own leases
+            sched.engine.manager.check_consistency(
+                external=tree.block_ref_counts())
+            prefix = tree.stats()
+            d_hits = prefix["hits"] - stats0["hits"]
+            d_miss = prefix["misses"] - stats0["misses"]
+            prefix["trace_hit_rate"] = round(
+                d_hits / max(d_hits + d_miss, 1), 4)
+            prefix["trace_evictions"] = prefix["evictions"] \
+                - stats0["evictions"]
+        return {
+            "tok_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 2),
+            "completed": done,
+            "ttft_shared_p99_ms": p99(shared_ttfts),
+            "ttft_shared_p50_ms": round(float(np.percentile(
+                np.asarray(shared_ttfts), 50)), 3),
+            "ragged_retraces": monitor.get("serving.ragged_retraces"),
+            "leaked_blocks": leaked,
+            "preemptions": monitor.get("serving.preemptions"),
+            "prefix": prefix,
+        }
+
+    cached = run_trace(prefix_cache=True)
+    cold = run_trace(prefix_cache=False)
+    ttft_speedup = round(
+        cold["ttft_shared_p99_ms"] / cached["ttft_shared_p99_ms"], 2)
+    tok_speedup = round(cached["tok_s"] / cold["tok_s"], 2)
+
+    # hard in-run checks: the acceptance contract (ISSUE 12)
+    assert cached["completed"] == n_requests and \
+        cold["completed"] == n_requests, (cached, cold)
+    assert ttft_speedup > 3.0, \
+        f"shared-prefix TTFT p99 speedup {ttft_speedup}x <= 3x " \
+        f"(cached {cached['ttft_shared_p99_ms']} ms vs cold " \
+        f"{cold['ttft_shared_p99_ms']} ms)"
+    assert tok_speedup > 1.5, \
+        f"tok/s speedup {tok_speedup}x <= 1.5x " \
+        f"(cached {cached['tok_s']} vs cold {cold['tok_s']})"
+    assert cached["ragged_retraces"] == 0 and \
+        cold["ragged_retraces"] == 0, \
+        "ragged step retraced mid-trace: block sharing must be pure " \
+        "host bookkeeping"
+    assert cached["leaked_blocks"] == 0 and cold["leaked_blocks"] == 0, \
+        (cached["leaked_blocks"], cold["leaked_blocks"])
+    assert cached["prefix"]["trace_evictions"] > 0, \
+        "pool never pressured the tree: eviction path unexercised " \
+        f"({cached['prefix']})"
+    assert cached["prefix"]["trace_hit_rate"] > 0.6, cached["prefix"]
+    assert cached["prefix"]["cow_copies"] > 0, \
+        f"no divergent append ever COWed ({cached['prefix']})"
+
+    extras = {
+        "requests": n_requests,
+        "shared_prefix_tokens": prefix_len,
+        "shared_fraction": 0.8,
+        "poisson_mean_gap_ms": mean_gap_s * 1e3,
+        "cached": cached,
+        "cold": cold,
+        "ttft_shared_p99_ms": cached["ttft_shared_p99_ms"],
+        "ttft_speedup_x": ttft_speedup,
+        "tok_s_speedup_x": tok_speedup,
+        "probe": probe,
+        "device": jax.devices()[0].device_kind or "cpu",
+    }
+    _emit_report({
+        "metric": "serving_shared_prefix_tok_s",
+        "value": cached["tok_s"],
+        "unit": f"tok/s on the 80% shared-prefix trace "
+                f"({tok_speedup}x vs no cache; shared TTFT p99 "
+                f"{cached['ttft_shared_p99_ms']} ms = 1/{ttft_speedup} "
+                f"of cold; hit rate "
+                f"{cached['prefix']['trace_hit_rate']})",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "serving_shared_prefix")
+
+
 @scenario("serving_fleet", 420)
 def serving_fleet_main():
     """`python bench.py serving_fleet` — the multi-replica ROUTER scaling

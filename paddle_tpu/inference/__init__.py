@@ -7,12 +7,16 @@ decode stack (paged KV cache + fused multi-transformer, §2.3 fusion kernels).
 Components:
 - `Config` / `create_predictor` / `Predictor`: handle-based execution of
   jit-saved StableHLO programs (predictor.py).
-- `BlockCacheManager`: paged KV-cache block tables (cache.py).
+- `BlockCacheManager`: paged KV-cache block tables with refcounted
+  copy-on-write sharing (cache.py).
+- `RadixPrefixCache`: shared-prefix radix tree over the paged pool —
+  committed KV reused across requests/sessions (prefix_cache.py).
 - `LlamaInferenceEngine` / `GenerationConfig`: fused scan-over-layers
   prefill+decode programs with the Pallas paged-attention kernel
   (llama_runner.py).
 """
 from .cache import BlockCacheManager, KVCacheExhausted, SequenceTooLong
+from .prefix_cache import RadixPrefixCache
 from .llama_runner import GenerationConfig, LlamaInferenceEngine
 from .predictor import (Config, DataType, PlaceType, Predictor,
                         PredictorTensor, create_predictor, get_version)
@@ -20,6 +24,6 @@ from .predictor import (Config, DataType, PlaceType, Predictor,
 __all__ = [
     "Config", "DataType", "PlaceType", "Predictor", "PredictorTensor",
     "create_predictor", "get_version", "BlockCacheManager",
-    "KVCacheExhausted", "SequenceTooLong",
+    "KVCacheExhausted", "RadixPrefixCache", "SequenceTooLong",
     "GenerationConfig", "LlamaInferenceEngine",
 ]

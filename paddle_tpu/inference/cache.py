@@ -7,15 +7,28 @@ fixed-size blocks; each sequence holds a block table mapping logical block
 index → physical block id. Allocation/free is O(1) host bookkeeping —
 device arrays never reallocate, which keeps XLA programs static-shaped.
 
+Physical blocks are REFERENCE COUNTED: several leases (sequences, or the
+radix prefix tree in `inference/prefix_cache.py`) may point at the same
+physical block, which is how a shared system prompt's KV is prefilled
+once and attended by every request that carries it. A block returns to
+the free list only when its last lease drops. Writes into a shared block
+trigger COPY-ON-WRITE (`append_tokens`): the writer gets a private copy
+(the optional `cow_hook` copies the device-side KV), every other lease
+keeps the original bytes — a divergent `append` after a `trim` into a
+shared region can never corrupt a sibling's context.
+
 Exhaustion is a *scheduling event*, not a crash: `allocate`/`append_token`
 raise the typed `KVCacheExhausted` (pool empty) or `SequenceTooLong`
 (per-sequence block cap), which the continuous-batching scheduler
 (`paddle_tpu.serving.scheduler`) consumes to queue or preempt requests.
+Before raising `KVCacheExhausted` the manager first asks its registered
+`reclaimer` (the prefix tree) to evict unpinned cached blocks — cached
+prefixes are capacity opportunistically held, never capacity denied.
 """
 from __future__ import annotations
 
 import sys as _sys
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -30,6 +43,18 @@ def _chaos(site: str) -> None:
     mod = _sys.modules.get("paddle_tpu.resilience.faults")
     if mod is not None:
         mod.check(site)
+
+
+def _monitor_inc(name: str, n: int = 1) -> None:
+    """Weak monitor bump (same sys.modules guard as `_chaos`): cache.py
+    stays import-light, but COW copies are a serving-level counter
+    (`serving.prefix_cache.cow_copies`) when the monitor is loaded."""
+    mod = _sys.modules.get("paddle_tpu.framework.monitor")
+    if mod is not None:
+        try:
+            mod.inc(name, n)
+        except Exception:
+            pass
 
 
 class KVCacheExhausted(RuntimeError):
@@ -74,8 +99,20 @@ class BlockCacheManager:
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: Dict[int, List[int]] = {}
         self._lens: Dict[int, int] = {}
+        # physical block -> lease count, for every block OUT of the free
+        # list. A plain (no-sharing) workload keeps every count at 1 and
+        # pays one dict write per block transition.
+        self._refs: Dict[int, int] = {}
         self._guard_ids: set = set()   # guard seqs, so utilization() is
         #                                O(#guards) on the admission path
+        # copy-on-write plumbing: `cow_hook(src, dst)` copies the
+        # device-side KV of one physical block (engines provide it via
+        # `copy_kv_block`); None = bookkeeping-only COW (tests, engines
+        # without device state). `reclaimer` is asked to free unpinned
+        # cached blocks before KVCacheExhausted surfaces.
+        self._cow_hook: Optional[Callable[[int, int], None]] = None
+        self._reclaimer = None
+        self.cow_copies = 0            # lifetime COW count (this manager)
         # memory observability registry (weak; same sys.modules guard
         # pattern as _chaos — processes that never import observability
         # pay one dict lookup at construction, nothing per op)
@@ -94,6 +131,81 @@ class BlockCacheManager:
     def num_seqs(self) -> int:
         return len(self._tables)
 
+    # ---- refcounted block primitives ----
+    def _take_free(self) -> int:
+        b = self._free.pop()
+        self._refs[b] = 1
+        return b
+
+    def incref(self, block: int) -> None:
+        """Add one lease to an already-allocated physical block (the
+        prefix tree pins published blocks this way)."""
+        n = self._refs[block] + 1
+        self._refs[block] = n
+        if n == 2 and self._reclaimer is not None:
+            # 1 -> 2: a cached block just got a second lease (pinned)
+            self._note_ref(block, n)
+
+    def release_block(self, block: int) -> None:
+        """Drop one lease; the block returns to the free pool when the
+        last lease goes (the prefix tree's eviction path)."""
+        self._release(block)
+
+    def _release(self, b: int) -> None:
+        n = self._refs[b] - 1
+        if n:
+            self._refs[b] = n
+            if n == 1 and self._reclaimer is not None:
+                # 2 -> 1: the cache may be the only lease left (unpinned)
+                self._note_ref(b, n)
+        else:
+            del self._refs[b]
+            self._free.append(b)
+
+    def _note_ref(self, block: int, n: int) -> None:
+        """Tell the reclaimer a block crossed the pinned/unpinned
+        boundary — how `RadixPrefixCache.reclaimable()` stays O(1) on
+        the per-submit admission path instead of walking the tree."""
+        try:
+            self._reclaimer.note_ref(block, n)
+        except Exception:
+            pass
+
+    def ref_count(self, block: int) -> int:
+        """Current lease count of a physical block (0 = free)."""
+        return self._refs.get(block, 0)
+
+    def set_cow_hook(self, hook: Optional[Callable[[int, int], None]]):
+        """`hook(src_block, dst_block)` copies device KV on COW."""
+        self._cow_hook = hook
+
+    def set_reclaimer(self, reclaimer) -> None:
+        """Register the cache-eviction authority: an object with
+        `evict(n_blocks) -> int` (free at least n unpinned cached
+        blocks, best-effort) and `reclaimable() -> int`. Called under
+        pool pressure BEFORE `KVCacheExhausted` is raised."""
+        self._reclaimer = reclaimer
+
+    def reclaimable_blocks(self) -> int:
+        """Blocks held only by the cache tree (refcount 1 from the
+        reclaimer) — free-on-demand capacity."""
+        if self._reclaimer is None:
+            return 0
+        try:
+            return int(self._reclaimer.reclaimable())
+        except Exception:
+            return 0
+
+    def _ensure_free(self, need: int) -> None:
+        """Best-effort: reclaim cached blocks until `need` are free.
+        Never raises — the caller re-checks and raises the typed
+        exhaustion itself."""
+        if need > len(self._free) and self._reclaimer is not None:
+            try:
+                self._reclaimer.evict(need - len(self._free))
+            except Exception:
+                pass
+
     @staticmethod
     def _is_guard(seq_id) -> bool:
         """Guard/infrastructure sequences hold sacrificial padding blocks
@@ -105,22 +217,32 @@ class BlockCacheManager:
         return sum(len(self._tables[sid]) for sid in self._guard_ids)
 
     def utilization(self) -> float:
-        """Fraction of the usable pool currently held by REAL sequences.
+        """Fraction of the usable pool currently held by REAL demand.
 
-        Guard blocks are excluded from both sides of the ratio: they are
-        leased forever, so counting them as "used" put a permanent floor
-        under apparent utilization and skewed the admission-control KV
-        watermarks (PR 6) exactly when pools are small."""
+        Counted over PHYSICAL blocks — a block shared by N leases is one
+        block of pressure, not N (per-lease summing would inflate past
+        1.0 under prefix sharing and false-trip the admission KV
+        watermarks). Guard blocks are excluded from both sides of the
+        ratio (leased forever = a permanent floor, not load), and so are
+        cache-held reclaimable blocks: the prefix tree surrenders them
+        on demand, so they are free capacity wearing a cache hat — the
+        watermark ladder must not shed over them."""
         guard = self._guard_blocks()
-        used = self.num_blocks - len(self._free) - guard
-        return used / max(self.num_blocks - guard, 1)
+        used = self.num_blocks - len(self._free) - guard \
+            - self.reclaimable_blocks()
+        return max(0, used) / max(self.num_blocks - guard, 1)
 
     def fragmentation(self) -> Dict:
         """Fragmentation view of the pool (observability/memory.py):
 
         - per-sequence leased-vs-used blocks and token counts (`per_seq`);
         - token-level internal fragmentation: leased block capacity vs
-          tokens actually stored (partial last blocks);
+          tokens actually stored (partial last blocks); under sharing the
+          ratio is clamped at 0 (two sequences packing one physical block
+          is negative waste);
+        - sharing: `leased_blocks` counts a shared physical block ONCE
+          (`lease_count` keeps the per-lease sum, `shared_blocks` the
+          number of physical blocks with >1 lease);
         - free-list shape: largest contiguous run of free block ids and
           the fragmentation ratio `1 - largest_run / free` (0.0 = one
           clean run, →1.0 = free space shattered into single blocks —
@@ -136,7 +258,8 @@ class BlockCacheManager:
             largest_run = max(largest_run, run)
             prev = b
         per_seq = {}
-        leased = used = tokens = guard = 0
+        physical: set = set()
+        lease_count = used = tokens = guard = 0
         for sid, table in self._tables.items():
             if self._is_guard(sid):
                 guard += len(table)
@@ -146,9 +269,11 @@ class BlockCacheManager:
             per_seq[sid] = {"leased_blocks": n_leased,
                             "used_blocks": n_used,
                             "tokens": self._lens[sid]}
-            leased += n_leased
+            physical.update(table)
+            lease_count += n_leased
             used += n_used
             tokens += self._lens[sid]
+        leased = len(physical)
         capacity_tokens = leased * self.block_size
         return {
             "num_blocks": self.num_blocks,
@@ -156,11 +281,15 @@ class BlockCacheManager:
             "free_blocks": len(free),
             "guard_blocks": guard,
             "leased_blocks": leased,
+            "lease_count": lease_count,
+            "shared_blocks": sum(1 for n in self._refs.values() if n > 1),
+            "reclaimable_blocks": self.reclaimable_blocks(),
+            "cow_copies": self.cow_copies,
             "used_blocks": used,
             "tokens": tokens,
             "utilization": round(self.utilization(), 4),
-            "internal_frag_ratio": round(
-                1.0 - tokens / capacity_tokens, 4) if capacity_tokens
+            "internal_frag_ratio": round(max(
+                0.0, 1.0 - tokens / capacity_tokens), 4) if capacity_tokens
             else 0.0,
             "largest_free_run": largest_run,
             "free_fragmentation_ratio": round(
@@ -172,7 +301,8 @@ class BlockCacheManager:
         return max(1, (num_tokens + self.block_size - 1) // self.block_size)
 
     def can_allocate(self, num_tokens: int) -> bool:
-        return len(self._free) >= self.blocks_needed(num_tokens)
+        return len(self._free) + self.reclaimable_blocks() \
+            >= self.blocks_needed(num_tokens)
 
     def allocate(self, seq_id: int, num_tokens: int) -> List[int]:
         """Reserve blocks for a new sequence of `num_tokens` tokens.
@@ -187,14 +317,36 @@ class BlockCacheManager:
         need = self.blocks_needed(num_tokens)
         if need > self.max_blocks_per_seq:
             raise SequenceTooLong(need, self.max_blocks_per_seq)
+        self._ensure_free(need)
         if need > len(self._free):
             raise KVCacheExhausted(need, len(self._free), self.num_blocks)
-        blocks = [self._free.pop() for _ in range(need)]
+        blocks = [self._take_free() for _ in range(need)]
         self._tables[seq_id] = blocks
         self._lens[seq_id] = num_tokens
         if self._is_guard(seq_id):
             self._guard_ids.add(seq_id)
         return blocks
+
+    def adopt(self, seq_id: int, blocks: List[int],
+              num_tokens: int) -> List[int]:
+        """Create a sequence whose table STARTS with already-allocated
+        (shared) physical blocks — the prefix-tree lease path. Each
+        block gains one lease (incref); `num_tokens` of KV in them are
+        the sequence's context. The table grows past them through the
+        normal `append_tokens` path (COW fires if the first append lands
+        inside the last shared block)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        if len(blocks) > self.max_blocks_per_seq:
+            raise SequenceTooLong(len(blocks), self.max_blocks_per_seq)
+        if num_tokens > len(blocks) * self.block_size:
+            raise ValueError("adopt: num_tokens exceeds block capacity")
+        _chaos("serve.cache")
+        for b in blocks:
+            self.incref(b)
+        self._tables[seq_id] = list(blocks)
+        self._lens[seq_id] = num_tokens
+        return list(blocks)
 
     def append_token(self, seq_id: int) -> None:
         """Account one generated token; grows the table on block boundary."""
@@ -205,6 +357,13 @@ class BlockCacheManager:
         one pending token + K draft tokens per step), growing the block
         table across as many block boundaries as needed.
 
+        Copy-on-write: when the first new token lands inside a block
+        whose refcount is >1 (a shared prefix leased from the radix
+        tree, or a `trim` back into shared territory followed by a
+        divergent append), the block is copied to a fresh private block
+        first (`cow_hook` moves the device KV) — the other leases keep
+        the original bytes.
+
         All-or-nothing: on `SequenceTooLong`/`KVCacheExhausted` neither the
         length nor the table changes, so the caller can retry with a
         smaller `n` (fewer drafts) or preempt — the same contract
@@ -213,36 +372,72 @@ class BlockCacheManager:
         if n < 0:
             raise ValueError(f"append_tokens: n must be >= 0, got {n}")
         _chaos("serve.cache")
-        new_len = self._lens[seq_id] + n
+        old_len = self._lens[seq_id]
+        new_len = old_len + n
         table = self._tables[seq_id]
         need = self.blocks_needed(new_len) - len(table)
-        if need > 0:
-            if len(table) + need > self.max_blocks_per_seq:
-                raise SequenceTooLong(len(table) + need,
-                                      self.max_blocks_per_seq)
-            if need > len(self._free):
-                raise KVCacheExhausted(need, len(self._free), self.num_blocks)
-            for _ in range(need):
-                table.append(self._free.pop())
+        # COW trigger: the FIRST new token's write target is an existing
+        # table block (not a fresh allocation) that other leases share —
+        # either a partial shared block (old_len mid-block) or a full
+        # shared block the lease kept past a boundary-capped prefix hit
+        cow_idx = None
+        if n > 0:
+            idx = old_len // self.block_size
+            if idx < len(table) and self._refs[table[idx]] > 1:
+                cow_idx = idx
+        extra = 1 if cow_idx is not None else 0
+        if need > 0 and len(table) + need > self.max_blocks_per_seq:
+            raise SequenceTooLong(len(table) + need,
+                                  self.max_blocks_per_seq)
+        if max(need, 0) + extra > len(self._free):
+            self._ensure_free(max(need, 0) + extra)
+        if max(need, 0) + extra > len(self._free):
+            raise KVCacheExhausted(max(need, 0) + extra, len(self._free),
+                                   self.num_blocks)
+        if cow_idx is not None:
+            self._cow(seq_id, cow_idx)
+        for _ in range(max(need, 0)):
+            table.append(self._take_free())
         self._lens[seq_id] = new_len
+
+    def _cow(self, seq_id: int, idx: int) -> int:
+        """Copy block `idx` of `seq_id`'s table into a fresh private
+        block (caller guarantees a free block exists). The device copy
+        runs BEFORE any bookkeeping mutates, so a failing hook leaves
+        the pool exactly as it was."""
+        table = self._tables[seq_id]
+        src = table[idx]
+        dst = self._free.pop()
+        if self._cow_hook is not None:
+            try:
+                self._cow_hook(src, dst)
+            except Exception:
+                self._free.append(dst)
+                raise
+        self._refs[dst] = 1
+        self._release(src)             # caller checked > 1: never frees
+        table[idx] = dst
+        self.cow_copies += 1
+        _monitor_inc("serving.prefix_cache.cow_copies")
+        return dst
 
     def trim(self, seq_id: int, num_tokens: int) -> None:
         """Shrink a sequence to `num_tokens` tokens, returning surplus
-        blocks to the pool. Used after bucket-padded prefill: the engine
-        prefills at a padded length (bounded compile count), then the real
-        prompt length is restored here so the padding blocks don't stay
-        leased."""
+        blocks to the pool (shared blocks just drop this sequence's
+        lease). Used for speculative-decode rollback and padded-prefill
+        cleanup; trimming INTO a shared block is safe — the next
+        divergent append COWs it."""
         if num_tokens > self._lens[seq_id]:
             raise ValueError("trim can only shrink a sequence")
         keep = self.blocks_needed(num_tokens)
         table = self._tables[seq_id]
         while len(table) > keep:
-            self._free.append(table.pop())
+            self._release(table.pop())
         self._lens[seq_id] = num_tokens
 
     def free(self, seq_id: int) -> None:
         for b in self._tables.pop(seq_id):
-            self._free.append(b)
+            self._release(b)
         self._lens.pop(seq_id)
         self._guard_ids.discard(seq_id)
 
@@ -254,6 +449,46 @@ class BlockCacheManager:
         an unknown sequence). Lets the serving watchdog audit for leaks
         without reaching into private tables."""
         return len(self._tables.get(seq_id, ()))
+
+    def blocks_of(self, seq_id: int) -> Tuple[int, ...]:
+        """The physical block ids leased by `seq_id` in logical order
+        (empty for an unknown sequence) — the prefix tree's publish
+        input and the leak auditor's unique-set input."""
+        return tuple(self._tables.get(seq_id, ()))
+
+    def check_consistency(self, external: Optional[Dict[int, int]] = None):
+        """Invariant audit (tests / chaos smoke): free list unique and
+        disjoint from live refs, every pool block accounted exactly
+        once, every refcount positive and — when `external` maps block
+        -> lease count held by non-sequence owners (the prefix tree) —
+        exactly equal to table appearances + external leases. Raises
+        AssertionError naming the broken invariant (a double-freed
+        shared block shows up here as a duplicate free-list entry or a
+        refcount mismatch)."""
+        free = self._free
+        assert len(free) == len(set(free)), "duplicate free-list entry"
+        assert not (set(free) & set(self._refs)), \
+            "block both free and referenced"
+        assert len(free) + len(self._refs) == self.num_blocks, \
+            f"pool accounting broken: {len(free)} free + " \
+            f"{len(self._refs)} live != {self.num_blocks}"
+        assert all(n >= 1 for n in self._refs.values()), \
+            "non-positive refcount"
+        counts: Dict[int, int] = {}
+        for table in self._tables.values():
+            for b in table:
+                counts[b] = counts.get(b, 0) + 1
+        if external is not None:
+            for b, n in external.items():
+                counts[b] = counts.get(b, 0) + n
+            assert counts == self._refs, \
+                f"refcount mismatch: tables+external {counts} != " \
+                f"refs {self._refs}"
+        else:
+            for b, n in counts.items():
+                assert self._refs.get(b, 0) >= n, \
+                    f"block {b}: {n} table leases > refcount " \
+                    f"{self._refs.get(b, 0)}"
 
     def block_table_array(self, seq_ids, pad: int = 0) -> np.ndarray:
         """Dense [len(seq_ids), max_blocks_per_seq] int32 table.

@@ -175,6 +175,14 @@ class LlamaInferenceEngine:
             _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
         self._ragged = jax.jit(functools.partial(
             _ragged_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
+        # COW device copy (prefix caching, `BlockCacheManager` hook):
+        # copies one physical block's K and V across every layer in one
+        # donated executable; src/dst trace as int32 scalars, so COWs
+        # never recompile
+        self._copy_block = jax.jit(
+            lambda k, v, s, d: (k.at[:, d].set(k[:, s]),
+                                v.at[:, d].set(v[:, s])),
+            donate_argnums=(0, 1))
 
     def cost_card_args(self, phase: str):
         """Observability hook (`observability.costs.ensure_engine_card`):
@@ -268,6 +276,12 @@ class LlamaInferenceEngine:
             np.asarray(context_lens, np.int32),
             np.asarray(block_tables, np.int32))
         return logits
+
+    def copy_kv_block(self, src: int, dst: int) -> None:
+        """Copy one physical KV block, all layers (`BlockCacheManager`
+        COW hook — the scheduler wires it when prefix caching is on)."""
+        self.k_cache, self.v_cache = self._copy_block(
+            self.k_cache, self.v_cache, np.int32(src), np.int32(dst))
 
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  **kw) -> np.ndarray:

@@ -44,6 +44,13 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
     "serving_mixed": [("value", "higher"),
                       ("extras.tpot_p99_during_prefill_ms", "lower")],
     "kernel_micro": [("value", "higher")],
+    # shared-prefix radix caching (ROADMAP item 1): throughput on the
+    # 80 %-shared-prefix trace and tail TTFT of the shared requests
+    # (the population the cache exists for) must not regress; the
+    # cached-vs-cold speedup ratios are asserted in-run (>3x TTFT p99,
+    # >1.5x tok/s) and carried as evidence
+    "serving_shared_prefix": [("value", "higher"),
+                              ("extras.ttft_shared_p99_ms", "lower")],
     # fleet-router scaling (ROADMAP item 5): aggregate throughput at the
     # top replica count, the 1->4 scaling ratio (the router-overhead
     # contract — near-linear or the control plane is serializing
@@ -78,6 +85,10 @@ SCENARIO_GATE_PCT: Dict[str, float] = {
     # last-good ratchet pins the baseline to the luckiest run ever seen;
     # the in-run scaling asserts (>=1.7x/3x) are the hard contract
     "serving_fleet": 25.0,
+    # open-loop Poisson walls on a contended CPU box: the in-run
+    # cached-vs-cold ratio asserts are the hard contract, the gate
+    # catches order-of-magnitude regressions
+    "serving_shared_prefix": 25.0,
 }
 
 

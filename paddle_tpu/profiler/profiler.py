@@ -514,6 +514,31 @@ class Profiler:
                 f"sample retraces {g('serving.sample_retraces')})")
         if rejected:
             lines.append("  reject reasons: " + cls._kv_join(rejected))
+        # Prefix cache block: only rendered once the radix cache saw an
+        # admission (hits + misses > 0) — docs/SERVING.md "Prefix
+        # caching & multi-tenant SLOs"
+        p = lambda k: snap.get(f"serving.prefix_cache.{k}", 0)  # noqa: E731
+        if p("hits") or p("misses"):
+            lines.append(
+                f"  Prefix cache: {p('hits')} hits / {p('misses')} misses "
+                f"({p('hit_rate_pct')}% of admissions), "
+                f"{p('hit_tokens')} prefill tokens served from cache; "
+                f"{p('evictions')} evictions, {p('cow_copies')} COW copies")
+            if p("ttft_cached_p50_ms") or p("ttft_cold_p50_ms"):
+                lines.append(
+                    f"    TTFT p50 cached {p('ttft_cached_p50_ms')} ms "
+                    f"vs cold {p('ttft_cold_p50_ms')} ms")
+        tenants = sorted({k.split(".")[2] for k in snap
+                          if k.startswith("serving.tenant.")})
+        if tenants:
+            parts = []
+            for t in tenants:
+                adm = snap.get(f"serving.tenant.{t}.admitted", 0)
+                defer = sum(v for k, v in snap.items() if k.startswith(
+                    f"serving.tenant.{t}.deferred."))
+                parts.append(f"{t}={adm} admitted"
+                             + (f" ({defer} deferred)" if defer else ""))
+            lines.append("  tenants: " + ", ".join(parts))
         # Overload/faults block: only rendered when the fault-tolerance
         # layer actually acted (shed, isolated, restarted, or stalled)
         if (g("serving.shed_total") or g("serving.isolated_faults")

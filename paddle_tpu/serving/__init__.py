@@ -27,6 +27,13 @@ Components:
   shedding, the `EngineStepError` isolation boundary, `WatchdogConfig`
   bounded engine restarts, typed `EngineStalled` — every submitted
   request reaches a terminal status no matter what the engine does.
+- prefix caching (`inference/prefix_cache.py`, enabled via
+  `prefix_cache=True`): shared-prefix radix tree over the paged pool
+  with copy-on-write refcounting — repeated prompts and multi-turn
+  sessions skip the cached part of prefill entirely.
+- `SLOClass`/`SLOConfig` (slo.py): multi-tenant SLO scheduling —
+  per-tenant KV quotas and reserves, deficit-weighted decode-lane
+  allocation, latency-tier watermark scaling.
 - `FleetRouter` (fleet.py): the data-parallel replica tier — N
   frontends behind load-aware session-affine dispatch, elastic
   membership with incarnation-fenced heartbeats, and replica-failure
@@ -40,6 +47,7 @@ from .fleet import FleetHandle, FleetRouter, ReplicaHandle
 from .frontend import RequestHandle, ServingFrontend
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
+from .slo import SLOClass, SLOConfig
 from .spec import (DraftEngineProposer, NGramProposer, Proposer,
                    SpecDecodeConfig)
 
@@ -48,6 +56,6 @@ __all__ = [
     "EngineStepError", "FleetHandle", "FleetRouter", "MLPLMEngine",
     "NGramProposer", "Proposer", "ReplicaHandle", "Request",
     "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
-    "ServingFrontend", "ServingMetrics", "SpecDecodeConfig",
-    "WatchdogConfig",
+    "ServingFrontend", "ServingMetrics", "SLOClass", "SLOConfig",
+    "SpecDecodeConfig", "WatchdogConfig",
 ]

@@ -252,6 +252,20 @@ class MLPLMEngine:
         self._ragged = jax.jit(
             functools.partial(_mlp_ragged, block_size=block_size),
             donate_argnums=(1,))
+        # COW device copy (prefix caching): one traced executable, the
+        # cache donated so the copy is in-place-ish; src/dst are traced
+        # int32 scalars, so repeated COWs never recompile
+        self._copy_block = jax.jit(lambda c, s, d: c.at[d].set(c[s]),
+                                   donate_argnums=(0,))
+
+    def copy_kv_block(self, src: int, dst: int) -> None:
+        """Copy one physical cache block (`BlockCacheManager` COW hook —
+        wired by the scheduler when prefix caching is on). The block's
+        whole [block_size, D] slab moves; positions past the writer's
+        divergence point are overwritten or never attended (masked by
+        context length)."""
+        self.cache = self._copy_block(self.cache, np.int32(src),
+                                      np.int32(dst))
 
     def respawn(self) -> "MLPLMEngine":
         """Build a fresh engine with IDENTICAL weights (seed-derived) and

@@ -118,14 +118,21 @@ class ReplicaHandle:
 
     def load(self) -> dict:
         """The live load snapshot: placement input AND the heartbeat
-        payload published to the membership store."""
+        payload published to the membership store. `prefix_hit_rate` is
+        the replica's OWN radix-cache hit rate (0.0 with the cache off)
+        — advisory evidence that a session's radix path lives here, so
+        session-affine dispatch keeps landing its turns where the KV
+        already is."""
         s = self.frontend.scheduler
+        pstats = s.prefix_stats()
         return {
             "queue_depth": len(s.waiting),
             "running": s.num_running,
             "queued_cost": s._queued_cost,
             "kv_utilization": round(s.engine.manager.utilization(), 4),
             "tokens_generated": self.tokens_produced,
+            "prefix_hit_rate": (pstats["hit_rate"] if pstats else 0.0),
+            "prefix_cached_blocks": (pstats["nodes"] if pstats else 0),
         }
 
     def __repr__(self):
@@ -363,7 +370,8 @@ class FleetRouter:
                eos_token_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
                stream_cb=None, seed: int = 0,
-               session_id: Optional[str] = None) -> FleetHandle:
+               session_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> FleetHandle:
         """`ServingFrontend.submit` fleet-wide: place on the session's
         home replica (when `session_id` is given and its replica lives)
         or the least-loaded replica; a shed/queue-full answer retries on
@@ -384,7 +392,7 @@ class FleetRouter:
             cb = lambda req, tok, _cb=stream_cb: _cb(tok)  # noqa: E731
         req = Request(prompt_ids, sampling=sp,
                       deadline=None if timeout_s is None
-                      else now + timeout_s, stream_cb=cb)
+                      else now + timeout_s, stream_cb=cb, tenant=tenant)
         req.session_id = session_id
         fh = FleetHandle(req, max_new_tokens, session_id)
         _monitor.inc("fleet.submitted")
@@ -677,7 +685,7 @@ class FleetRouter:
         key straggler attribution feeds on."""
         snaps = []
         _no_load = {"queue_depth": 0, "running": 0, "queued_cost": 0,
-                    "kv_utilization": 0.0}
+                    "kv_utilization": 0.0, "prefix_hit_rate": 0.0}
         for rep in self._replicas:
             # a dead replica's scheduler is frozen pre-crash state, not
             # load — report its historical throughput, zero its load
@@ -691,6 +699,8 @@ class FleetRouter:
                 "fleet.queued_cost": ld["queued_cost"],
                 "fleet.kv_utilization_pct":
                     round(ld["kv_utilization"] * 100.0, 1),
+                "fleet.prefix_hit_rate_pct":
+                    round(ld.get("prefix_hit_rate", 0.0) * 100.0, 1),
                 "mesh.step_wall_ms": rep.last_step_wall_ms,
             })
         return snaps

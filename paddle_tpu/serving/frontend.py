@@ -111,6 +111,8 @@ class ServingFrontend:
                  engine_factory=None,
                  stall_after: int = 512,
                  prefill_chunk_tokens: int = 32,
+                 prefix_cache: bool = False,
+                 slo=None,
                  clock=time.perf_counter):
         """`spec`: optional `SpecDecodeConfig` enabling speculative
         decoding (proposer + fixed draft length K) for every request
@@ -128,7 +130,12 @@ class ServingFrontend:
         instead of spinning on a wedged engine.
         `prefill_chunk_tokens`: per-step pending-prompt token budget for
         chunked prefill (docs/SERVING.md "Ragged batching & chunked
-        prefill" — the TPOT-vs-TTFT knob). `clock`: time source for
+        prefill" — the TPOT-vs-TTFT knob). `prefix_cache`: enable the
+        shared-prefix radix cache — repeated prompts/sessions skip the
+        cached part of prefill entirely (docs/SERVING.md "Prefix caching
+        & multi-tenant SLOs"). `slo`: optional `SLOConfig` of per-tenant
+        quotas, decode-lane weights, and latency-tier watermark scaling;
+        submissions then carry `tenant=`. `clock`: time source for
         deadlines, latency stamps, and stall detection — shared with the
         scheduler so fake-clock tests never mix time bases."""
         self.metrics = metrics or ServingMetrics()
@@ -138,6 +145,7 @@ class ServingFrontend:
                                    admission=admission, watchdog=watchdog,
                                    engine_factory=engine_factory,
                                    prefill_chunk_tokens=prefill_chunk_tokens,
+                                   prefix_cache=prefix_cache, slo=slo,
                                    clock=clock)
         self.default_timeout_s = default_timeout_s
         self.stall_after = stall_after
@@ -147,11 +155,14 @@ class ServingFrontend:
                temperature: float = 0.0, top_k: int = 0,
                eos_token_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
-               stream_cb=None, seed: int = 0) -> RequestHandle:
+               stream_cb=None, seed: int = 0,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue a generation request. NEVER raises on load conditions:
         a request that cannot be served comes back already-terminal with
         `finish_reason` in {prompt_too_long, queue_full, empty_prompt}
-        (REJECTED) or a watermark/deadline reason (SHED)."""
+        (REJECTED) or a watermark/deadline reason (SHED). `tenant` names
+        the request's SLO class when an `SLOConfig` is installed
+        (unknown/None -> the default class)."""
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
         now = self._clock()
         deadline = None if timeout_s is None else now + timeout_s
@@ -162,7 +173,7 @@ class ServingFrontend:
         if stream_cb is not None:
             cb = lambda req, tok, _cb=stream_cb: _cb(tok)  # noqa: E731
         req = Request(prompt_ids, sampling=sp, deadline=deadline,
-                      stream_cb=cb)
+                      stream_cb=cb, tenant=tenant)
         self.scheduler.submit(req, now=now)
         return RequestHandle(req)
 

@@ -45,6 +45,12 @@ class ServingMetrics:
     def __init__(self):
         self.ttft_s = deque(maxlen=_WINDOW)
         self.tpot_s = deque(maxlen=_WINDOW)
+        # cached-vs-cold TTFT split (shared-prefix radix caching): a
+        # request admitted WITH a prefix-cache hit lands in `cached`,
+        # everything else in `cold` — the side-by-side distribution the
+        # prefix cache's whole existence is judged on
+        self.ttft_cached_s = deque(maxlen=_WINDOW)
+        self.ttft_cold_s = deque(maxlen=_WINDOW)
         # per-step acceptance-rate samples (speculative decoding) — same
         # bounded-window contract as the latency deques: a long-running
         # server must never grow a sample list
@@ -60,6 +66,8 @@ class ServingMetrics:
         warmup/measurement boundary) without touching monitor counters."""
         self.ttft_s.clear()
         self.tpot_s.clear()
+        self.ttft_cached_s.clear()
+        self.ttft_cold_s.clear()
         self.accept_rate.clear()
         self._occ_sum = 0.0
         self._steps = 0
@@ -143,6 +151,36 @@ class ServingMetrics:
             # fixed-bucket histogram: the Prometheus-scrapable latency
             # distribution (percentile gauges below stay for summary())
             monitor.observe("serving.ttft_seconds", t)
+            if getattr(req, "_prefix_hit_tokens", 0) > 0:
+                self.ttft_cached_s.append(t)
+                monitor.observe("serving.ttft_cached_seconds", t)
+            else:
+                self.ttft_cold_s.append(t)
+                monitor.observe("serving.ttft_cold_seconds", t)
+
+    # ---- shared-prefix radix cache ----
+    def on_prefix_lease(self, hit_tokens: int):
+        """One admission through the radix prefix cache: `hit_tokens`
+        context tokens were served from cache (0 = miss). The raw
+        `serving.prefix_cache.{hits,misses,hit_tokens,evictions}`
+        counters are bumped at their source (`prefix_cache.py`;
+        `cow_copies` in `cache.py`) — this hook derives the rate
+        gauge."""
+        hits = monitor.get("serving.prefix_cache.hits")
+        miss = monitor.get("serving.prefix_cache.misses")
+        if hits + miss:
+            monitor.set_gauge("serving.prefix_cache.hit_rate_pct",
+                              round(hits / (hits + miss) * 100.0, 1))
+
+    # ---- multi-tenant SLO classes ----
+    def on_tenant_admit(self, tenant: str):
+        monitor.inc(f"serving.tenant.{tenant}.admitted")
+
+    def on_tenant_deferred(self, tenant: str, reason: str):
+        """A tenant's head-of-queue request was passed over this
+        admission round (kv_quota / kv_reserve) WITHOUT blocking other
+        tenants — quota pressure made visible."""
+        monitor.inc(f"serving.tenant.{tenant}.deferred.{reason}")
 
     def on_finish(self, req):
         from .scheduler import RequestStatus
@@ -223,6 +261,10 @@ class ServingMetrics:
     def _publish_latency(self):
         for name, val in (("serving.ttft_p50_ms", _pct(self.ttft_s, 50)),
                           ("serving.ttft_p99_ms", _pct(self.ttft_s, 99)),
+                          ("serving.prefix_cache.ttft_cached_p50_ms",
+                           _pct(self.ttft_cached_s, 50)),
+                          ("serving.prefix_cache.ttft_cold_p50_ms",
+                           _pct(self.ttft_cold_s, 50)),
                           ("serving.tpot_mean_ms",
                            float(np.mean(self.tpot_s)) if self.tpot_s
                            else None)):
@@ -239,6 +281,15 @@ class ServingMetrics:
         out["serving.ttft_p99_ms"] = _r(_pct(self.ttft_s, 99))
         out["serving.tpot_mean_ms"] = _r(
             float(np.mean(self.tpot_s)) if self.tpot_s else None)
+        if self.ttft_cached_s or self.ttft_cold_s:
+            out["serving.prefix_cache.ttft_cached_p50_ms"] = _r(
+                _pct(self.ttft_cached_s, 50))
+            out["serving.prefix_cache.ttft_cached_p99_ms"] = _r(
+                _pct(self.ttft_cached_s, 99))
+            out["serving.prefix_cache.ttft_cold_p50_ms"] = _r(
+                _pct(self.ttft_cold_s, 50))
+            out["serving.prefix_cache.ttft_cold_p99_ms"] = _r(
+                _pct(self.ttft_cold_s, 99))
         return out
 
     @staticmethod

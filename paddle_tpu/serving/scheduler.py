@@ -12,6 +12,16 @@ Policy (documented in docs/SERVING.md):
   cache chunk-by-chunk through the ragged step, sized to the TRUE
   context (no bucket padding, no `trim`-back). Pool exhaustion
   (`KVCacheExhausted`) leaves it queued — never crashes.
+- prefix caching (optional, `prefix_cache=True`): a radix tree over
+  the paged pool publishes committed KV at finish/preemption and leases
+  the deepest cached prefix at admission (refcount bump, zero prefill
+  for the hit; chunking resumes from the first uncached block — a full
+  hit makes TTFT ≈ one decode step). Divergent writes into shared
+  blocks copy-on-write; unpinned tree nodes LRU-evict under pressure.
+- multi-tenant SLOs (optional, `slo=SLOConfig(...)`): per-tenant KV
+  quotas/reserves gate admission without cross-tenant head blocking,
+  decode lanes allocate by deficit-weighted fair queuing, and each
+  latency tier scales the overload watermarks with its own latches.
 - load shedding (optional `AdmissionConfig`): watermark latches with
   hysteresis over queue depth, queued `max_new_tokens` cost, and KV
   utilization, plus deadline-aware early shedding — overload degrades to
@@ -72,12 +82,14 @@ from .. import observability as _obs
 from ..framework import monitor as _monitor
 from ..framework.retry import Budget, retry_call
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
+from ..inference.prefix_cache import RadixPrefixCache
 from ..ops.sampling import sample_tokens
 from ..resilience import faults as _faults
 from .engine import EngineCore
 from .fault_tolerance import (AdmissionConfig, EngineStepError,
                               OverloadController, WatchdogConfig)
 from .metrics import ServingMetrics
+from .slo import DEFAULT_TENANT, SLOConfig
 from .spec import SpecDecodeConfig
 
 __all__ = ["SamplingParams", "RequestStatus", "Request", "Scheduler"]
@@ -123,12 +135,16 @@ class Request:
 
     def __init__(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                  deadline: Optional[float] = None,
-                 stream_cb: Optional[Callable[["Request", int], None]] = None):
+                 stream_cb: Optional[Callable[["Request", int], None]] = None,
+                 tenant: str = DEFAULT_TENANT):
         self.req_id = next(Request._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.sampling = sampling or SamplingParams()
         self.deadline = deadline              # absolute, scheduler's clock
         self.stream_cb = stream_cb
+        # multi-tenant SLO class (serving/slo.py): quota, lane weight,
+        # and watermark tier all key off this; "default" = untiered
+        self.tenant = tenant or DEFAULT_TENANT
         self.generated: List[int] = []
         self.status = RequestStatus.QUEUED
         self.finish_reason: Optional[str] = None
@@ -148,9 +164,11 @@ class Request:
         # chunked-prefill cursor: context tokens whose KV is already in
         # cache (reset at every (re-)admission; the target snapshot is
         # taken then too, so re-prefill after preemption replays the
-        # full prompt + kept tokens)
+        # full prompt + kept tokens). A radix prefix-cache hit starts
+        # the cursor AT the hit length — those tokens never prefill.
         self._prefill_ctx = np.zeros((0,), np.int32)
         self._prefill_pos = 0
+        self._prefix_hit_tokens = 0           # cached tokens this admission
         self._chunks = 0
         self._t_admit: Optional[float] = None
 
@@ -210,13 +228,28 @@ class Scheduler:
                  engine_factory: Optional[Callable[[], EngineCore]] = None,
                  nan_checks: bool = True,
                  prefill_chunk_tokens: int = 32,
+                 prefix_cache: bool = False,
+                 slo: Optional[SLOConfig] = None,
                  clock: Callable[[], float] = time.perf_counter):
         """`prefill_chunk_tokens`: per-step token budget for pending
         prompts — the packed ragged dispatch holds `max_batch_size +
         prefill_chunk_tokens` token slots. Larger chunks finish prefill
         in fewer steps (better TTFT); smaller chunks bound how much a
         long prompt can stretch any single step (better decode TPOT
-        under mixed traffic). See docs/SERVING.md for tuning."""
+        under mixed traffic). See docs/SERVING.md for tuning.
+
+        `prefix_cache`: enable the shared-prefix radix cache
+        (`inference/prefix_cache.py`): committed prompt/response KV is
+        published block-wise at finish/preemption; a new request leases
+        the deepest cached prefix at admission (refcount bump, zero
+        prefill for those tokens) and chunked prefill resumes from the
+        first uncached block — a full hit makes TTFT ≈ one decode step.
+        Divergent writes into shared blocks copy-on-write; unpinned
+        cached blocks LRU-evict under pool pressure.
+
+        `slo`: optional multi-tenant `SLOConfig` (serving/slo.py):
+        per-tenant KV quotas/reserves, deficit-weighted decode-lane
+        allocation, and latency-tier watermark scaling."""
         if prefill_chunk_tokens < 1:
             raise ValueError("prefill_chunk_tokens must be >= 1, got "
                              f"{prefill_chunk_tokens}")
@@ -262,6 +295,18 @@ class Scheduler:
         self._gather_fn = None               # jitted last-row gather, lazy
         self._last_decode_dt: Optional[float] = None
         self._chunk_progress = 0             # prefill tokens last round
+        self._prefix_enabled = bool(prefix_cache)
+        self._prefix_tree: Optional[RadixPrefixCache] = None
+        self._slo = slo
+        # per-tenant overload controllers (tier-scaled watermarks, own
+        # hysteresis latches) and virtual-time clocks for the
+        # deficit-weighted lane allocator — both lazy. `_vclock` is the
+        # system virtual time (the last admission's start time): a
+        # tenant returning from idle is charged from max(own, _vclock),
+        # so it competes from NOW instead of spending banked arrears
+        self._overload_by_tenant = {}
+        self._vtime = {}
+        self._vclock = 0.0
         self._bind_manager(engine.manager)
 
     def _bind_manager(self, mgr):
@@ -284,6 +329,14 @@ class Scheduler:
         # What one sequence can ever hold: pool minus the guard (and minus
         # blocks other users of a shared engine already lease).
         self._usable_blocks = min(mgr.free_blocks, mgr.max_blocks_per_seq)
+        # radix prefix cache: built on THIS manager (and rebuilt with a
+        # fresh one after a watchdog engine swap — the old tree's KV
+        # died with the old device state); the engine's block-copy hook
+        # backs COW, and the tree is the pool's eviction authority
+        if self._prefix_enabled:
+            self._prefix_tree = RadixPrefixCache(mgr)
+            mgr.set_reclaimer(self._prefix_tree)
+            mgr.set_cow_hook(getattr(self.engine, "copy_kv_block", None))
 
     # ---- waiting-queue bookkeeping (cost-accounted) ----
     def _queue_push(self, req: Request, front: bool = False):
@@ -326,13 +379,14 @@ class Scheduler:
         if mgr.blocks_needed(len(req.prompt) + 1) > self._usable_blocks:
             return self._reject(req, "prompt_too_long")
         if self._overload is not None:
-            cfg = self._overload.cfg
+            ctrl = self._overload_for(req.tenant)
+            cfg = ctrl.cfg
             # the TPOT median only feeds the deadline estimate — don't
             # pay the numpy call on every no-deadline submit
             tpot = (self.tpot_estimate()
                     if cfg.deadline_aware and req.deadline is not None
                     else None)
-            reason = self._overload.shed_reason(
+            reason = ctrl.shed_reason(
                 queue_depth=len(self.waiting),
                 queued_cost=self._queued_cost,
                 req_cost=req.cost,
@@ -345,6 +399,50 @@ class Scheduler:
             return self._reject(req, "queue_full")
         self._queue_push(req)
         return req
+
+    def _overload_for(self, tenant: str) -> OverloadController:
+        """The overload controller for `tenant`: the shared base one
+        without an SLO config; with one, a per-tenant controller whose
+        watermarks are tier-scaled (`SLOClass.admission_scale`) and
+        whose hysteresis latches are private — a batch tier latching
+        shed must not shed the interactive tier."""
+        if self._slo is None:
+            return self._overload
+        ctrl = self._overload_by_tenant.get(tenant)
+        if ctrl is None:
+            c = self._slo.cls(tenant)
+            cfg = (self._overload.cfg if c.admission_scale == 1.0
+                   else c.scaled_admission(self._overload.cfg))
+            ctrl = OverloadController(cfg)
+            self._overload_by_tenant[tenant] = ctrl
+        return ctrl
+
+    def _tenant_held(self) -> dict:
+        """Pool blocks held per tenant (running slots only), counting
+        each running request at its COMMITTED footprint — the larger of
+        blocks leased now and blocks its admitted context will need —
+        so a quota can't overshoot while prefill chunks are still
+        landing. Per-lease counts: a shared prefix charges each tenant
+        holding it, the conservative reading of a quota."""
+        mgr = self.engine.manager
+        held: dict = {}
+        for r in self.slots:
+            if r is not None:
+                blocks = max(mgr.seq_blocks(r.seq_id),
+                             mgr.blocks_needed(len(r._prefill_ctx) + 1))
+                held[r.tenant] = held.get(r.tenant, 0) + blocks
+        return held
+
+    def prefix_stats(self) -> Optional[dict]:
+        """Per-instance prefix-cache counters (None with the cache
+        off) — what the fleet heartbeat payload reports per replica
+        (monitor counters are process-global)."""
+        t = self._prefix_tree
+        return None if t is None else t.stats()
+
+    @property
+    def prefix_cache(self) -> Optional[RadixPrefixCache]:
+        return self._prefix_tree
 
     def _reject(self, req: Request, reason: str) -> Request:
         req.status = RequestStatus.REJECTED
@@ -394,6 +492,7 @@ class Scheduler:
         for i, r in enumerate(self.slots):
             if r is req:
                 self.slots[i] = None
+                self._publish_prefix(req)
                 self.engine.manager.free(req.seq_id)
                 self._release_spec(req)
                 req.status = RequestStatus.PREEMPTED
@@ -485,16 +584,44 @@ class Scheduler:
         return float(np.median(np.asarray(self._tpot_samples)))
 
     def kv_leaked_blocks(self) -> int:
-        """Blocks leased in the manager that belong to neither the guard
-        nor a running sequence — must be 0 for a sole-tenant scheduler
-        (asserted by the chaos smoke after every injected fault)."""
+        """Blocks leased in the manager that belong to neither the
+        guard, a running sequence, nor the radix prefix tree — must be 0
+        for a sole-tenant scheduler (asserted by the chaos smoke after
+        every injected fault). Counted over UNIQUE physical blocks: a
+        shared block is one block however many leases point at it."""
         mgr = self.engine.manager
         held = mgr.num_blocks - mgr.free_blocks
-        legit = mgr.seq_blocks(self._pad_seq_id)
+        legit = set(mgr.blocks_of(self._pad_seq_id))
         for r in self.slots:
             if r is not None:
-                legit += mgr.seq_blocks(r.seq_id)
-        return held - legit
+                legit.update(mgr.blocks_of(r.seq_id))
+        if self._prefix_tree is not None:
+            legit.update(self._prefix_tree.blocks())
+        return held - len(legit)
+
+    def _publish_prefix(self, req: Request) -> None:
+        """Publish a departing request's committed context KV into the
+        radix tree (full blocks only), BEFORE the manager frees its
+        lease — a popular prompt's KV outlives its first request. A
+        prefilling lane publishes only the chunks already committed;
+        publication must never break the terminal-status path."""
+        tree = self._prefix_tree
+        if tree is None:
+            return
+        mgr = self.engine.manager
+        if not mgr.seq_blocks(req.seq_id):
+            return
+        try:
+            if req.prefilling:
+                toks = req._prefill_ctx[
+                    :min(req._prefill_pos, mgr.seq_len(req.seq_id))]
+            else:
+                toks = req.context_tokens()
+                toks = toks[:min(len(toks), mgr.seq_len(req.seq_id))]
+            if len(toks) >= mgr.block_size:
+                tree.publish(req.seq_id, toks)
+        except Exception:
+            pass
 
     # ---- fault boundary ----
     def _dispatch(self, phase: str, fn, *args):
@@ -757,18 +884,72 @@ class Scheduler:
                 self._finish(req, RequestStatus.TIMED_OUT,
                              "deadline_while_running", slot=i)
 
+    def _next_admit(self, mgr, skip: set) -> Optional[Request]:
+        """The next request to TRY admitting. Without an SLO config:
+        strict FIFO (the head). With one: deficit-weighted fair queuing
+        across tenants — each tenant's head request competes, the
+        eligible tenant with the lowest virtual time wins (admissions
+        cost `1/weight`), quota-capped tenants are skipped WITHOUT
+        blocking the others. Returns None when nothing is eligible."""
+        if self._slo is None:
+            return self.waiting[0]
+        heads = {}
+        for r in self.waiting:          # queue order -> FIFO tie-break
+            if r.tenant not in heads:
+                heads[r.tenant] = r
+        held = None
+        eligible = []
+        for t, r in heads.items():
+            if t in skip:
+                continue
+            c = self._slo.cls(t)
+            if c.kv_quota_blocks is not None:
+                if held is None:
+                    held = self._tenant_held()
+                need_all = mgr.blocks_needed(len(r.context_tokens()) + 1)
+                if held.get(t, 0) + need_all > c.kv_quota_blocks:
+                    skip.add(t)         # its own finishes free quota
+                    self.metrics.on_tenant_deferred(t, "kv_quota")
+                    continue
+            eligible.append((t, r))
+        if not eligible:
+            return None
+        # effective time = max(own clock, system clock): an idle
+        # tenant's stale low clock fast-forwards to NOW (the system
+        # clock only advances at admissions), so it cannot bank arrears
+        # while quiet and then monopolize every lane on return
+        _best_t, best_r = min(
+            eligible,
+            key=lambda tr: max(self._vtime.get(tr[0], 0.0), self._vclock))
+        return best_r
+
+    def _charge_admission(self, tenant: str) -> None:
+        if self._slo is not None:
+            start = max(self._vtime.get(tenant, 0.0), self._vclock)
+            self._vclock = start
+            self._vtime[tenant] = start \
+                + 1.0 / self._slo.cls(tenant).weight
+            self.metrics.on_tenant_admit(tenant)
+
     def _admit(self, now: float) -> int:
-        """Place queued requests into free slots. Admission leases only
-        the sequence id (a zero-token allocation = one block); the
-        prompt's KV then enters the cache chunk-by-chunk through the
-        ragged step — no bucket padding, no per-admission prefill
-        dispatch, and the lease always tracks the TRUE context length.
-        The first token samples when the final chunk completes (inside
-        the ragged round's commit loop)."""
+        """Place queued requests into free slots. Admission leases the
+        deepest radix-cached prefix of the context when the prefix cache
+        is on (refcount bump — those tokens never prefill; chunking
+        resumes from the first uncached block) and otherwise only the
+        sequence id (a zero-token allocation = one block); the remaining
+        KV enters the cache chunk-by-chunk through the ragged step — no
+        bucket padding, no per-admission prefill dispatch, and the lease
+        always tracks the TRUE context length. The first token samples
+        when the final chunk completes (inside the ragged round's commit
+        loop). Under an SLO config the admit order is tenant-fair
+        (`_next_admit`) and gated by per-tenant quotas and reserves."""
         mgr = self.engine.manager
         admitted = 0
+        skip: set = set()               # tenants deferred this round
         while self.waiting and None in self.slots:
-            req = self.waiting[0]
+            req = self._next_admit(mgr, skip)
+            if req is None:
+                break                  # every queued tenant deferred
             ctx = req.context_tokens()
             # admit only when the WHOLE context could lease right now —
             # the same admission pressure the full-prefill scheduler had
@@ -777,38 +958,67 @@ class Scheduler:
             # that outstanding demand is the prefill DEBT of admitted
             # lanes still mid-chunking, and must be subtracted or two
             # large prompts would both admit against the same free count
-            # and preempt-churn mid-prefill).
+            # and preempt-churn mid-prefill). Radix-cached blocks and
+            # tree-reclaimable blocks both count as capacity: a hit
+            # adopts shared blocks (no free-list draw), and the tree
+            # surrenders unpinned blocks on demand.
             debt = sum(
                 max(0, mgr.blocks_needed(len(r._prefill_ctx))
                     - mgr.seq_blocks(r.seq_id))
                 for r in self.slots if r is not None and r.prefilling)
-            if mgr.blocks_needed(len(ctx)) > mgr.free_blocks - debt:
+            hit_blocks = (self._prefix_tree.match_blocks(ctx)
+                          if self._prefix_tree is not None else 0)
+            need = mgr.blocks_needed(len(ctx)) - hit_blocks
+            headroom = mgr.free_blocks + mgr.reclaimable_blocks() - debt
+            if need > headroom:
                 break                  # blocks return as runners finish
+            if self._slo is not None:
+                reserve = self._slo.total_reserve_excluding(
+                    req.tenant, self._tenant_held())
+                if need > headroom - reserve:
+                    # honoring OTHER tenants' unused reserves: this
+                    # tenant waits, the others may still admit
+                    skip.add(req.tenant)
+                    self.metrics.on_tenant_deferred(req.tenant,
+                                                    "kv_reserve")
+                    continue
+            hit = 0
             try:
-                mgr.allocate(req.seq_id, 0)
+                if self._prefix_tree is not None:
+                    hit = self._prefix_tree.lease(req.seq_id, ctx)
+                if hit == 0:
+                    mgr.allocate(req.seq_id, 0)
             except (KVCacheExhausted, SequenceTooLong):
                 break
             except Exception:          # injected/corrupt cache state
-                self._queue_pop()
+                self._queue_remove(req)
                 self._isolated(req, "engine_fault:cache", "cache",
                                in_slot=False)
                 continue
-            self._queue_pop()
+            self._queue_remove(req)
             slot = self.slots.index(None)
             # snapshot the prefill target HERE: for a preempted
             # re-admission it includes the kept tokens, so the replay is
             # token-deterministic; the pending `_last` (when present)
-            # stays pending and decodes after the chunks complete
+            # stays pending and decodes after the chunks complete. A
+            # prefix hit starts the cursor AT the hit — chunking resumes
+            # from the first uncached token (a full hit leaves exactly
+            # one token: TTFT ≈ one decode step).
             req._prefill_ctx = ctx
-            req._prefill_pos = 0
+            req._prefill_pos = hit
+            req._prefix_hit_tokens = hit
             req._chunks = 0
             req._t_admit = self._clock()
             req.status = RequestStatus.RUNNING
             req._admit_seq = next(self._admit_counter)
             self.slots[slot] = req
             admitted += 1
+            self._charge_admission(req.tenant)
+            if self._prefix_tree is not None:
+                self.metrics.on_prefix_lease(hit)
             if _obs.enabled():
                 self._obs_req(req, "admitted", t0=req._t_admit, slot=slot,
+                              prefix_hit_tokens=hit or None,
                               queue_wait_ms=round(
                                   (req._t_admit - req.t_submit) * 1e3, 3)
                               if req.t_submit is not None else None)
@@ -898,6 +1108,7 @@ class Scheduler:
             return False
         _, slot = max(victims)
         req = self.slots[slot]
+        self._publish_prefix(req)
         self.engine.manager.free(req.seq_id)
         self._release_spec(req)
         self.slots[slot] = None
@@ -1392,6 +1603,10 @@ class Scheduler:
             if slot is None:
                 slot = self.slots.index(req)
             self.slots[slot] = None
+            if status is not RequestStatus.FAILED:
+                # a FAILED lane's KV may be poison (NaN isolation,
+                # engine fault) — never publish it into the shared tree
+                self._publish_prefix(req)
             self.engine.manager.free(req.seq_id)
         self._release_spec(req)
         req.status = status

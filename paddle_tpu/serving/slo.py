@@ -1,0 +1,122 @@
+"""Multi-tenant SLO classes for the serving scheduler (ROADMAP item 1).
+
+One engine serves MANY tenants (products, customers, internal batch
+jobs) whose latency expectations and capacity entitlements differ. This
+module is the policy layer the scheduler consults:
+
+- **KV quotas** (`kv_quota_blocks`): a hard per-tenant cap on leased
+  pool blocks — a tenant at quota keeps queueing (its own requests
+  finishing free capacity) WITHOUT blocking other tenants' admission.
+- **KV reserves** (`kv_reserve_blocks`): a guaranteed per-tenant
+  minimum — tenant A's admission must leave enough free (+ reclaimable
+  prefix-cache) capacity to honor every OTHER tenant's unused reserve,
+  so A's burst can never starve B's pinned entitlement.
+- **Decode-lane weights** (`weight`): admission into decode lanes is
+  deficit-weighted fair queuing across tenants with queued work
+  (virtual-time accounting: each admission costs `1/weight`, the
+  scheduler picks the eligible tenant with the lowest virtual time).
+  Within a tenant, service order stays FIFO. A weight-3 tenant gets ~3x
+  the lanes of a weight-1 tenant under contention; an idle tenant
+  accrues NO arrears (its clock fast-forwards on return), so a quiet
+  premium tenant cannot later monopolize the batch.
+- **Latency-tier admission** (`admission_scale`): scales the PR 6
+  watermark ladder per tenant — a `0.5` tier sheds at HALF the queue /
+  cost / KV watermarks of the base `AdmissionConfig`, so best-effort
+  traffic sheds early while interactive traffic keeps admitting. Each
+  tenant gets its own hysteresis latches (a batch tenant latching shed
+  must not shed the premium tenant).
+
+Unknown tenants fall back to the `default` class. With no `SLOConfig`
+installed the scheduler behaves exactly as before (single global FIFO).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from .fault_tolerance import AdmissionConfig
+
+__all__ = ["SLOClass", "SLOConfig"]
+
+DEFAULT_TENANT = "default"
+
+
+class SLOClass:
+    """One tenant tier's policy knobs."""
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 kv_quota_blocks: Optional[int] = None,
+                 kv_reserve_blocks: int = 0,
+                 admission_scale: float = 1.0):
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        if admission_scale <= 0:
+            raise ValueError(
+                f"admission_scale must be > 0, got {admission_scale}")
+        if kv_quota_blocks is not None and kv_quota_blocks < 1:
+            raise ValueError(
+                f"kv_quota_blocks must be >= 1, got {kv_quota_blocks}")
+        if kv_reserve_blocks < 0:
+            raise ValueError(
+                f"kv_reserve_blocks must be >= 0, got {kv_reserve_blocks}")
+        if kv_quota_blocks is not None \
+                and kv_reserve_blocks > kv_quota_blocks:
+            raise ValueError("kv_reserve_blocks cannot exceed "
+                             "kv_quota_blocks")
+        self.name = name
+        self.weight = float(weight)
+        self.kv_quota_blocks = kv_quota_blocks
+        self.kv_reserve_blocks = int(kv_reserve_blocks)
+        self.admission_scale = float(admission_scale)
+
+    def scaled_admission(self, cfg: AdmissionConfig) -> AdmissionConfig:
+        """The base watermark ladder scaled to this tier (deadline
+        semantics untouched — a deadline is the request's own)."""
+        s = self.admission_scale
+        scale_i = lambda v: None if v is None else max(0, int(round(v * s)))
+        scale_f = lambda v: None if v is None else v * s  # noqa: E731
+        return AdmissionConfig(
+            queue_high=scale_i(cfg.queue_high),
+            queue_low=scale_i(cfg.queue_low),
+            cost_high=scale_i(cfg.cost_high),
+            cost_low=scale_i(cfg.cost_low),
+            kv_high=scale_f(cfg.kv_high),
+            kv_low=scale_f(cfg.kv_low),
+            deadline_aware=cfg.deadline_aware,
+            deadline_headroom=cfg.deadline_headroom)
+
+    def __repr__(self):
+        return (f"SLOClass({self.name!r}, weight={self.weight}, "
+                f"quota={self.kv_quota_blocks}, "
+                f"reserve={self.kv_reserve_blocks}, "
+                f"admission_scale={self.admission_scale})")
+
+
+class SLOConfig:
+    """The tenant-class registry the scheduler consults.
+
+    `classes` may omit a `default` entry; one with weight 1 and no
+    quota is synthesized so unknown tenants always resolve."""
+
+    def __init__(self, classes: Iterable[SLOClass]):
+        self.classes: Dict[str, SLOClass] = {}
+        for c in classes:
+            if c.name in self.classes:
+                raise ValueError(f"duplicate SLO class {c.name!r}")
+            self.classes[c.name] = c
+        if DEFAULT_TENANT not in self.classes:
+            self.classes[DEFAULT_TENANT] = SLOClass(DEFAULT_TENANT)
+
+    def cls(self, tenant: Optional[str]) -> SLOClass:
+        return self.classes.get(tenant or DEFAULT_TENANT,
+                                self.classes[DEFAULT_TENANT])
+
+    def total_reserve_excluding(self, tenant: str,
+                                held: Dict[str, int]) -> int:
+        """Blocks that must stay available to honor every OTHER
+        tenant's unused reserve (`reserve - held`, floored at 0)."""
+        total = 0
+        for name, c in self.classes.items():
+            if name == tenant or not c.kv_reserve_blocks:
+                continue
+            total += max(0, c.kv_reserve_blocks - held.get(name, 0))
+        return total

@@ -354,10 +354,43 @@ class TestMembership:
                 assert entry["incarnation"] >= 1
                 pl = entry["payload"]
                 assert {"queue_depth", "running", "queued_cost",
-                        "kv_utilization",
-                        "tokens_generated"} <= set(pl)
+                        "kv_utilization", "tokens_generated",
+                        "prefix_hit_rate"} <= set(pl)
+                # prefix caching off on these replicas: rate reports 0.0
+                assert pl["prefix_hit_rate"] == 0.0
             r.run_until_idle()
             assert all(h.finished for h in hs)
+        finally:
+            r.close()
+
+    def test_heartbeat_payload_reports_replica_prefix_hit_rate(self):
+        """Session-affine dispatch evidence (ISSUE 12): the replica
+        holding a session's radix path reports its OWN hit rate in the
+        heartbeat payload; dispatch keeps landing the session there
+        (advisory — a dead home falls back to least-loaded exactly as
+        before, covered by the relocation tests)."""
+        r = FleetRouter(make_engine, num_replicas=2, heartbeat_every=1,
+                        frontend_kwargs={"prefix_cache": True})
+        try:
+            rng = np.random.default_rng(21)
+            prompt = rng.integers(1, VOCAB, 12).tolist()
+            h1 = r.submit(prompt, max_new_tokens=3, session_id="s1")
+            r.run_until_idle()
+            home = h1.replica_id
+            # turn 2 of the session: lands on the home replica and HITS
+            h2 = r.submit(prompt, max_new_tokens=3, session_id="s1")
+            assert h2.replica_id == home
+            r.run_until_idle()
+            assert h2.status is RequestStatus.FINISHED
+            r.step()                       # heartbeat_every=1: publish
+            pods = r.store.alive()
+            rates = {rid: e["payload"]["prefix_hit_rate"]
+                     for rid, e in pods.items()}
+            assert rates[home] > 0.0
+            others = [v for k, v in rates.items() if k != home]
+            assert all(v == 0.0 for v in others), rates
+            snaps = r.replica_snapshots()
+            assert any(s["fleet.prefix_hit_rate_pct"] > 0 for s in snaps)
         finally:
             r.close()
 

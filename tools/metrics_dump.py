@@ -8,11 +8,15 @@ way a Prometheus scraper would see it.
 Usage:
     python tools/metrics_dump.py [--format prom|json] [--prefix serving.]
                                  [--exec "python -c ..."-style snippet]
-                                 [--mesh]
+                                 [--mesh] [--prefix-cache]
 
 ``--mesh`` prints the coordinator-side cross-host aggregation
 (`monitor.aggregate_mesh`: summed counters, per-host step walls,
 straggler attribution) as JSON instead of the local registry.
+
+``--prefix-cache`` prints the shared-prefix radix cache section
+(`serving.prefix_cache.*` — hits/misses/hit_tokens/evictions/cow_copies
+plus the cached-vs-cold TTFT gauges) as a readable block.
 
 Examples:
     # render whatever a short serving run left in the registry
@@ -43,6 +47,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", action="store_true",
                     help="print the cross-host aggregation "
                          "(aggregate_mesh) as JSON")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    dest="prefix_cache",
+                    help="print the serving.prefix_cache.* section as a "
+                         "readable block")
     args = ap.parse_args(argv)
 
     from paddle_tpu.framework import monitor
@@ -50,6 +58,19 @@ def main(argv=None) -> int:
     if args.snippet:
         exec(compile(args.snippet, "<metrics_dump --exec>", "exec"), {})
 
+    if args.prefix_cache:
+        snap = monitor.snapshot("serving.prefix_cache.")
+        g = lambda k: snap.get(f"serving.prefix_cache.{k}", 0)  # noqa: E731
+        print("Prefix cache:")
+        print(f"  hits {g('hits')} / misses {g('misses')} "
+              f"({g('hit_rate_pct')}% hit rate), "
+              f"hit tokens {g('hit_tokens')}")
+        print(f"  evictions {g('evictions')}, cow copies {g('cow_copies')}")
+        print(f"  TTFT p50 cached {g('ttft_cached_p50_ms')} ms / "
+              f"cold {g('ttft_cold_p50_ms')} ms")
+        if not args.mesh:
+            # combined flags still print the other requested output
+            return 0
     if args.mesh:
         print(json.dumps(monitor.aggregate_mesh(args.prefix), indent=1,
                          sort_keys=True))

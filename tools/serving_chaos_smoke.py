@@ -17,6 +17,13 @@ shares the decode handler and is unit-tested), `serve.sample`,
 `serve.cache` — plus a persistent-fault run that exhausts the restart
 budget and must fail everything TYPED rather than hang.
 
+Prefix-cache pass (`serve.cache` with the radix cache ON): the fault
+fires while blocks are SHARED (refcount > 1 across requests + the
+tree). Afterwards: every request terminal, `kv_leaked_blocks()==0`
+counted over unique physical blocks incl. the tree's leases, refcount
+consistency (no shared block double-freed), survivor parity vs the
+unfaulted cached run.
+
 Fleet pass (`fleet.step`): the same contract FLEET-WIDE — a replica is
 killed mid-Poisson-burst (the armed `fleet.step` flag fires the chaos
 kill on the busiest replica), and afterwards: every request terminal,
@@ -257,6 +264,97 @@ def fleet_chaos(reference_tokens):
         router.close()
 
 
+def prefix_trace():
+    """Shared-prefix mix: 6 of 8 prompts carry one 12-token system
+    prefix (3 full blocks at block_size 4) plus a unique suffix — once
+    the first finisher publishes, later admissions lease shared blocks,
+    so the injected cache fault lands while refcounts are > 1."""
+    rng = np.random.default_rng(3)
+    shared = rng.integers(1, VOCAB, 12).tolist()
+    out = []
+    for i in range(8):
+        if i % 4 == 3:
+            out.append(rng.integers(1, VOCAB, 7).tolist())
+        else:
+            out.append(shared + rng.integers(1, VOCAB, 3).tolist())
+    return out
+
+
+def prefix_run(arm=None):
+    from paddle_tpu.serving import (ServingFrontend, ServingMetrics,
+                                    WatchdogConfig)
+
+    ServingMetrics.reset_monitor()
+    fe = ServingFrontend(
+        make_engine(), prefix_cache=True,
+        watchdog=WatchdogConfig(step_retries=2, max_restarts=MAX_RESTARTS),
+        engine_factory=make_engine, stall_after=256)
+    handles = [fe.submit(p, max_new_tokens=6) for p in prefix_trace()]
+    if arm is not None:
+        arm(handles)
+    fe.run_until_idle(max_steps=4000)
+    return fe, handles
+
+
+def prefix_chaos():
+    """Prefix-cache pass: a `serve.cache` fault fires while blocks are
+    SHARED (refcount > 1). Contract: every request terminal, zero
+    leaked blocks (unique-counted across sequences AND the radix tree),
+    no shared block double-freed (refcount consistency audit incl. the
+    tree's leases), survivors bitwise equal to the unfaulted cached
+    run."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import RequestStatus
+
+    faults.clear()
+    ref_fe, ref_h = prefix_run()
+    assert all(h.status is RequestStatus.FINISHED for h in ref_h), ref_h
+    ref_tree = ref_fe.scheduler.prefix_cache
+    assert ref_tree.hits >= 2, \
+        f"trace never shared blocks (hits {ref_tree.hits}) — the fault " \
+        f"would not land on shared state"
+    reference = [h.tokens for h in ref_h]
+
+    faults.clear()
+    # after_n=16: past admission allocates, into the mid-run append path
+    # where shared leases + COW live
+    fe, hs = prefix_run(arm=lambda _h: faults.inject(
+        "serve.cache", after_n=16, times=1))
+    faults.clear()
+    non_terminal = [h.request_id for h in hs if not h.finished]
+    assert not non_terminal, f"prefix: non-terminal {non_terminal}"
+    sched = fe.scheduler
+    tree = sched.prefix_cache
+    leaked = sched.kv_leaked_blocks()
+    assert leaked == 0, f"prefix: {leaked} leaked blocks"
+    mgr = sched.engine.manager
+    # no double-free: refcounts exactly match table + tree leases, the
+    # free list is duplicate-free, every block accounted once
+    mgr.check_consistency(external=tree.block_ref_counts())
+    assert mgr.free_blocks == mgr.num_blocks - 1 - tree.num_nodes, \
+        f"prefix: pool holds {mgr.num_blocks - mgr.free_blocks} != " \
+        f"guard + {tree.num_nodes} tree nodes"
+    failed = [h for h in hs if h.status is RequestStatus.FAILED]
+    mismatch = [i for i, (h, ref) in enumerate(zip(hs, reference))
+                if h.status is RequestStatus.FINISHED and h.tokens != ref]
+    assert not mismatch, f"prefix: survivor mismatch at {mismatch}"
+    report = {
+        "scenario": "serve.cache:prefix_shared",
+        "finished": sum(h.status is RequestStatus.FINISHED for h in hs),
+        "failed": len(failed),
+        "tree_nodes": tree.num_nodes,
+        "prefix_hits": tree.hits,
+        "cow_copies": mgr.cow_copies,
+        "leaked_blocks": leaked,
+        "double_free": False,
+        "survivor_parity": True,
+        "restarts": monitor.get("serving.engine_restarts"),
+    }
+    print(json.dumps(report))
+    return report
+
+
 def main():
     from paddle_tpu.resilience import faults
     from paddle_tpu.serving import EngineStepError, RequestStatus
@@ -334,6 +432,9 @@ def main():
                     "typed": True})
     print(json.dumps(reports[-1]))
 
+    # prefix-cache pass: serve.cache fault while blocks are shared
+    reports.append(prefix_chaos())
+
     # fleet-wide pass: unkilled reference, then the mid-burst replica kill
     faults.clear()
     ref_router, ref_handles = fleet_run()
@@ -351,6 +452,7 @@ def main():
         "secs": round(time.time() - t0, 1),
         "contract": "all requests terminal, restarts <= budget, "
                     "0 leaked blocks, survivor greedy parity, "
+                    "prefix cache: shared-block fault -> no double-free, "
                     "fleet: replica kill -> relocation parity, "
                     "relocations <= budget, survivors leak-free",
     }))
