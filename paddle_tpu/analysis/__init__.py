@@ -1,0 +1,40 @@
+"""paddle_tpu.analysis — the framework's own static-analysis suite.
+
+Eleven PRs of review hardening kept re-finding the same bug classes by
+hand: use-after-donate reads (PR 3), host syncs and per-call device_puts
+on the decode path (PR 10), observability sites that allocate before
+checking the enable bool (PR 7/8's zero-cost-off contract), and compiled
+programs silently growing host transfers or collectives (PR 8's census
+exists but nothing gated on it). This package machine-checks those
+invariants:
+
+- **Tier A** (`passes.py`, stdlib-`ast` only, no jax import): five
+  source passes — use-after-donate, trace-hazard, hot-path discipline,
+  zero-cost-off, lock/thread hygiene — declared against `registry.py`
+  and in-source pragmas, ratcheted by `ptlint_baseline.json`
+  (`findings.py`).
+- **Tier B** (`hlo_audit.py`, needs jax): lowers the registered bench
+  executables (train step, ragged decode, verify, sampler) and checks
+  the compiled HLO against the committed `hlo_manifest.json` —
+  collective budgets, zero host-transfer ops on decode, no f32 gemms in
+  declared-bf16 programs.
+
+`tools/ptlint.py` is the CLI and the CI gate (exit 0 clean / 1 new
+findings / 2 config error, mirroring `tools/bench_diff.py`). It loads
+THIS package standalone so the tier-A path never imports jax — safe on
+any box, next to a busy TPU, and fast enough to ride every tier-1 run.
+docs/STATIC_ANALYSIS.md is the operator manual.
+"""
+from __future__ import annotations
+
+from .findings import (BaselineError, Finding, baseline_file,
+                       baseline_pass, compare_to_baseline, finding_counts,
+                       load_baseline, save_baseline, save_baseline_counts)
+from .passes import PASS_IDS, collect_files, scan_file, scan_paths
+
+__all__ = [
+    "Finding", "BaselineError", "finding_counts", "load_baseline",
+    "save_baseline", "save_baseline_counts", "compare_to_baseline",
+    "baseline_file", "baseline_pass",
+    "PASS_IDS", "scan_file", "scan_paths", "collect_files",
+]

@@ -1,0 +1,159 @@
+"""Findings + the ratchet baseline (the ptlint gate's bookkeeping).
+
+A finding is one rule violation at one source location. The gate is
+**ratchet-only**: a committed ``ptlint_baseline.json`` suppresses the
+findings that existed when the gate was introduced, so
+
+- a NEW finding (not in the baseline) fails the run (exit 1),
+- a FIXED finding leaves its baseline entry STALE, which also fails —
+  the fixer must shrink the baseline (``--update-baseline``), so the
+  suppression file can only ever ratchet toward empty and never rots
+  into a blanket waiver.
+
+Baseline entries are keyed **location-independently**
+(``pass|file|scope|symbol`` with a count), so unrelated edits that move
+line numbers don't churn the gate; two identical violations in the same
+function aggregate into one entry with count 2.
+
+STDLIB-ONLY: this module (like the whole tier-A suite) must be loadable
+standalone (``tools/ptlint.py`` does exactly that) with no jax — and no
+paddle_tpu — import.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "finding_counts", "load_baseline", "save_baseline",
+           "save_baseline_counts", "compare_to_baseline", "baseline_file",
+           "baseline_pass", "BaselineError"]
+
+BASELINE_VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Unusable baseline file (missing version, wrong shape) — a CONFIG
+    error (ptlint exit 2), distinct from findings (exit 1)."""
+
+
+class Finding:
+    """One rule violation: pass id + location + stable key + fix hint."""
+
+    __slots__ = ("pass_id", "path", "line", "col", "scope", "symbol",
+                 "message", "hint")
+
+    def __init__(self, pass_id: str, path: str, line: int, col: int,
+                 scope: str, symbol: str, message: str, hint: str = ""):
+        self.pass_id = pass_id
+        self.path = path          # repo-relative, forward slashes
+        self.line = line
+        self.col = col
+        self.scope = scope        # qualified function ("" = module level)
+        self.symbol = symbol      # what was flagged (stable across edits)
+        self.message = message
+        self.hint = hint
+
+    @property
+    def key(self) -> str:
+        """Line-independent identity used by the baseline."""
+        return f"{self.pass_id}|{self.path}|{self.scope}|{self.symbol}"
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_id, "file": self.path, "line": self.line,
+                "col": self.col, "scope": self.scope, "symbol": self.symbol,
+                "message": self.message, "hint": self.hint, "key": self.key}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.pass_id}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def __repr__(self):
+        return f"Finding({self.pass_id} {self.path}:{self.line} {self.symbol})"
+
+
+def finding_counts(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.key] = out.get(f.key, 0) + 1
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Read a committed baseline. Raises BaselineError on a malformed
+    file; a missing file is the caller's decision (empty vs error)."""
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"baseline {path}: not JSON ({e})")
+    if not isinstance(data, dict) or "findings" not in data:
+        raise BaselineError(f"baseline {path}: expected "
+                            '{"version", "findings"} object')
+    if data.get("version") != BASELINE_VERSION:
+        raise BaselineError(f"baseline {path}: version "
+                            f"{data.get('version')!r} != {BASELINE_VERSION}")
+    fnd = data["findings"]
+    if not isinstance(fnd, dict) or not all(
+            isinstance(k, str) and isinstance(v, int) and v > 0
+            for k, v in fnd.items()):
+        raise BaselineError(f"baseline {path}: findings must map "
+                            "key -> positive count")
+    return dict(fnd)
+
+
+def save_baseline_counts(path: str, counts: Dict[str, int]) -> Dict[str, int]:
+    """The ONE serializer (version constant has one owner); `counts` is
+    a key -> count map as produced by :func:`finding_counts`."""
+    counts = {k: counts[k] for k in sorted(counts) if counts[k] > 0}
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": counts},
+                  f, indent=1, sort_keys=False)
+        f.write("\n")
+    return counts
+
+
+def save_baseline(path: str, findings: List[Finding]) -> Dict[str, int]:
+    return save_baseline_counts(path, finding_counts(findings))
+
+
+def baseline_file(key: str) -> str:
+    """The repo-relative file of a baseline key ("" if malformed).
+    key = "pass|file|scope|symbol"."""
+    parts = key.split("|")
+    return parts[1] if len(parts) >= 2 else ""
+
+
+def baseline_pass(key: str) -> str:
+    return key.split("|", 1)[0]
+
+
+def compare_to_baseline(
+        findings: List[Finding], baseline: Dict[str, int],
+        scanned_files: Optional[List[str]] = None,
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Ratchet compare. Returns ``(new_findings, stale_entries)``.
+
+    - new_findings: findings beyond their baselined count (per key, the
+      first `baseline[key]` occurrences are suppressed).
+    - stale_entries: baseline keys whose finding no longer exists (or
+      whose count shrank) — keyed to the surplus count. Restricted to
+      `scanned_files` when given, so a partial-tree run (the tier-1 gate
+      scans serving/ + inference/ only) never calls the rest of the
+      repo's baseline stale.
+    """
+    counts = finding_counts(findings)
+    budget = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            new.append(f)
+    scanned = set(scanned_files) if scanned_files is not None else None
+    stale = {k: v for k, v in budget.items()
+             if v > 0 and counts.get(k, 0) < baseline.get(k, 0)
+             and (scanned is None or baseline_file(k) in scanned)}
+    return new, stale

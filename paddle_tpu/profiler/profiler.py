@@ -345,7 +345,7 @@ class Profiler:
                     "dur": (e.end - e.start) * 1e6,
                     "pid": os.getpid(), "tid": e.tid,
                 })
-        if tl_events:
+        if _obs.enabled() and tl_events:
             events.extend(_obs.timeline.chrome_events(base))
         if _obs.enabled():
             # pid "comms": per-kind collective tracks + step-overlap

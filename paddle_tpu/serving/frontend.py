@@ -83,6 +83,11 @@ class RequestHandle:
         rounds -> (preempted ->) terminal."""
         from .. import observability as _obs
 
+        if not _obs.enabled():
+            # zero-cost-off: no ring walk while disabled — and a stale
+            # ring from an earlier, since-disabled session must not leak
+            # into a "disabled" read
+            return []
         return [e.as_dict() for e in _obs.timeline.events()
                 if e.req_id == self._req.req_id]
 

@@ -80,6 +80,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..framework import monitor as _monitor
+from ..profiler import RecordEvent
 from ..framework.retry import Budget, retry_call
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
 from ..inference.prefix_cache import RadixPrefixCache
@@ -1216,8 +1217,6 @@ class Scheduler:
                 continue
             tables[i] = mgr.block_table_array([req.seq_id])[0]
         all_lanes = decode_lanes + [(i, r) for i, r, _n, _p in chunks]
-        from ..profiler import RecordEvent
-
         def probe(i, req):
             """Replay ONE lane of the failed step (same fixed shapes, so
             no recompile; KV writes are position-indexed and idempotent
@@ -1449,8 +1448,6 @@ class Scheduler:
                     and req._last is None:
                 lane_reqs[i] = req
             pre_lens[req.seq_id] = pre_len
-        from ..profiler import RecordEvent
-
         def probe(i, req):
             t = np.zeros((B, S), np.int32)
             t[i] = tokens[i]

@@ -1,0 +1,867 @@
+"""ptlint — the framework-native static-analysis suite (ISSUE 13).
+
+Per-pass fixture snippets (a seeded bug that MUST be flagged at its
+exact file:line, next to the clean idiom that must NOT be), the
+baseline ratchet's exit-code contract through the real CLI, the
+``--json`` machine surface, and the tier-B HLO audit — both the pure
+text checks against a doctored manifest and one real lowering proving
+the ragged decode executable compiles zero host-transfer ops.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (Finding, compare_to_baseline,
+                                 finding_counts, scan_file, scan_paths)
+from paddle_tpu.analysis import registry as reg
+from paddle_tpu.analysis.hlo_audit import (ManifestError, audit_text,
+                                           dtype_gemm_census,
+                                           host_transfer_census,
+                                           load_manifest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PTLINT = os.path.join(REPO, "tools", "ptlint.py")
+
+
+def _scan(tmp_path, source, relpath="fixture.py", passes=None):
+    p = tmp_path / os.path.basename(relpath)
+    p.write_text(textwrap.dedent(source))
+    return scan_file(str(p), relpath, passes)
+
+
+def _by_pass(findings, pass_id):
+    return [f for f in findings if f.pass_id == pass_id]
+
+
+# ---------------------------------------------------------------------------
+# pass: use-after-donate
+# ---------------------------------------------------------------------------
+
+class TestUseAfterDonate:
+    def test_read_after_donating_call_flagged_at_line(self, tmp_path):
+        fs = _scan(tmp_path, """\
+            import jax, functools
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(
+                        functools.partial(_impl, k=2), donate_argnums=(1,))
+                    self.cache = None
+
+                def run(self, x):
+                    out = self._step(x, self.cache)
+                    return out + self.cache.sum()
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert f.line == 11 and "self.cache" in f.message
+        assert "DONATED" in f.message and f.scope == "Engine.run"
+
+    def test_rebound_from_results_is_clean(self, tmp_path):
+        fs = _scan(tmp_path, """\
+            import jax
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(_impl, donate_argnums=(1,))
+                    self.cache = None
+
+                def run(self, x):
+                    out, self.cache = self._step(x, self.cache)
+                    return out + self.cache.sum()
+            """)
+        assert _by_pass(fs, "use-after-donate") == []
+
+    def test_module_level_jit_and_reassign_before_read(self, tmp_path):
+        fs = _scan(tmp_path, """\
+            import jax
+
+            _train = jax.jit(_step, donate_argnums=(0,))
+
+            def bad(params, grads):
+                new = _train(params, grads)
+                return params, new
+
+            def ok(params, grads):
+                params = _train(params, grads)
+                return params
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert f.scope == "bad" and f.line == 7
+
+    def test_tie_line_read_on_rebind_statement_flagged(self, tmp_path):
+        # `params = rescale(params)` after the donating call: the RHS
+        # reads the deleted buffer BEFORE the store rebinds it
+        fs = _scan(tmp_path, """\
+            import jax
+
+            _train = jax.jit(_step, donate_argnums=(0,))
+
+            def run(params, grads):
+                loss = _train(params, grads)
+                params = rescale(params)
+                return loss, params
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert f.line == 7
+
+    def test_augassign_read_flagged_and_else_branch_clean(self, tmp_path):
+        # `params += 1` READS the deleted buffer before rebinding it;
+        # a read in the mutually-exclusive else-arm never follows the
+        # donation and must not flag
+        fs = _scan(tmp_path, """\
+            import jax
+
+            _train = jax.jit(_step, donate_argnums=(0,))
+
+            def aug(params, grads):
+                out = _train(params, grads)
+                params += 1
+                return out
+
+            def branch(params, grads, warm):
+                if warm:
+                    out = _train(params, grads)
+                else:
+                    out = params.sum()
+                return out
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert f.scope == "aug" and f.line == 7
+
+    def test_loop_carried_read_flagged_store_first_clean(self, tmp_path):
+        # the donation also kills the buffer for the NEXT iteration: a
+        # read at an earlier line in the loop body executes after it
+        fs = _scan(tmp_path, """\
+            import jax
+
+            _train = jax.jit(_step, donate_argnums=(0,))
+
+            def bad(params, batches):
+                for b in batches:
+                    log(params)
+                    params2 = _train(params, b)
+                return params2
+
+            def ok(batches):
+                for b in batches:
+                    params = make(b)
+                    out = _train(params, b)
+                return out
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert f.scope == "bad" and f.line == 7
+
+    def test_donate_argnames_keyword(self, tmp_path):
+        fs = _scan(tmp_path, """\
+            import jax
+
+            _f = jax.jit(_impl, donate_argnames=("state",))
+
+            def run(state, x):
+                out = _f(x, state=state)
+                return out + state
+            """)
+        (f,) = _by_pass(fs, "use-after-donate")
+        assert "state" in f.symbol
+
+
+# ---------------------------------------------------------------------------
+# pass: trace-hazard
+# ---------------------------------------------------------------------------
+
+class TestTraceHazard:
+    def test_hazards_in_decorated_jit(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax, time
+            import numpy as np
+
+            @jax.jit
+            def f(x, y):
+                if x > 0:
+                    y = y + 1
+                t = time.time()
+                v = float(x)
+                z = np.asarray(y)
+                w = x.item()
+                return y
+            """), "trace-hazard")
+        symbols = {(f.line, f.symbol) for f in fs}
+        assert symbols == {(6, "if:x"), (8, "time.time"), (9, "float()"),
+                           (10, "np.asarray"), (11, ".item()")}
+        assert all(f.scope == "f" for f in fs)
+
+    def test_assigned_jit_with_partial_statics(self, tmp_path):
+        # jit site: jax.jit(functools.partial(_fn, block_size=...)) —
+        # the partial-bound kwarg is static; `if block_size` is fine,
+        # `if tokens` is not
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax, functools
+
+            def _fn(params, tokens, block_size):
+                if block_size > 2:
+                    tokens = tokens * 2
+                if tokens > 0:
+                    tokens = tokens + 1
+                return tokens
+
+            _jit = jax.jit(functools.partial(_fn, block_size=4))
+            """), "trace-hazard")
+        assert [(f.line, f.symbol) for f in fs] == [(6, "if:tokens")]
+
+    def test_shape_metadata_access_is_clean(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 1:
+                    x = x + 1
+                n = int(x.shape[0])
+                m = len(x.shape)
+                return x
+            """), "trace-hazard")
+        assert fs == []
+
+    def test_is_none_check_is_clean(self, tmp_path):
+        # the standard optional-arg idiom: None is pytree structure,
+        # never a tracer — `x is None` resolves at trace time
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def f(x, mask=None):
+                if mask is None:
+                    return x
+                if mask is not None and x.ndim > 1:
+                    x = x * mask
+                if mask:
+                    x = x + 1
+                return x
+            """), "trace-hazard")
+        assert [(f.line, f.symbol) for f in fs] == [(9, "if:mask")]
+
+    def test_kwonly_params_static_by_convention(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def f(x, *, num_slots):
+                if num_slots > 4:
+                    x = x * 2
+                return x
+            """), "trace-hazard")
+        assert fs == []
+
+    def test_static_param_host_conversion_is_clean(self, tmp_path):
+        # float()/int() on a declared-STATIC param is trace-time
+        # arithmetic, not a host sync — only traced values flag
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax, functools
+
+            def _fn(params, tokens, block_size, *, num_slots):
+                scale = 1.0 / float(block_size)
+                cap = int(num_slots)
+                bad = float(tokens)
+                return params * scale
+
+            _jit = jax.jit(functools.partial(_fn, block_size=4))
+            """), "trace-hazard")
+        assert [(f.line, f.symbol) for f in fs] == [(6, "float()")]
+
+    def test_host_rng_in_traced_fn(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                noise = np.random.normal(0, 1, x.shape)
+                return x + noise
+            """), "trace-hazard")
+        assert [f.symbol for f in fs] == ["np.random.normal"]
+        assert "TRACE time" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# pass: hot-path
+# ---------------------------------------------------------------------------
+
+HOT_FIXTURE = """\
+    import jax.numpy as jnp
+    from paddle_tpu.framework import monitor
+    from .. import observability as _obs
+
+    def sample(logits):   # ptlint: hot-path
+        import numpy as np
+        arr = jnp.asarray(logits)
+        monitor.inc("serving.samples")
+        print("sampled")
+        if _obs.enabled():
+            monitor.inc("serving.obs_samples")
+        return arr
+
+    def cold(logits):
+        import numpy as np
+        print("fine here")
+        return jnp.asarray(logits)
+    """
+
+
+class TestHotPath:
+    def test_pragma_declared_hot_path(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, HOT_FIXTURE), "hot-path")
+        assert {(f.line, f.symbol) for f in fs} == {
+            (6, "import:numpy"), (7, "jnp.asarray"),
+            (8, "monitor.inc"), (9, "print")}
+        # the gated monitor write (line 11) and the cold function are clean
+        assert all(f.scope == "sample" for f in fs)
+
+    def test_registry_declared_hot_path(self, tmp_path):
+        # relpath matching the registry entry makes the function hot
+        # with no pragma: the scheduler's real dispatch discipline
+        fs = _by_pass(_scan(tmp_path, """\
+            class Scheduler:
+                def _dispatch(self, phase, fn, *args):
+                    import json
+                    return fn(*args)
+            """, relpath="serving/scheduler.py"), "hot-path")
+        assert [f.symbol for f in fs] == ["import:json"]
+
+    def test_nested_closures_are_cold(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            def step(self):   # ptlint: hot-path
+                def probe(i):
+                    print("fault forensics, not per-call")
+                    return open("/tmp/x")
+                return 1
+            """), "hot-path")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# pass: zero-cost-off
+# ---------------------------------------------------------------------------
+
+ZCO_FIXTURE = """\
+    from .. import observability as _obs
+
+    def finish_bad(req, clock):
+        _obs.timeline.request_event(req, "terminal", clock())
+
+    def finish_ok(req, clock):
+        if _obs.enabled():
+            _obs.timeline.request_event(req, "terminal", clock())
+
+    def helper(req):   # ptlint: gated-callee
+        _obs.timeline.dispatch_span("x", 0.0, 1.0)
+
+    def caller_bad(req):
+        helper(req)
+
+    def caller_ok(req):
+        obs_on = _obs.enabled()
+        if obs_on:
+            helper(req)
+
+    def early_exit_ok(req):
+        if not _obs.enabled():
+            return
+        _obs.timeline.dump_flight("reason")
+    """
+
+
+class TestZeroCostOff:
+    def test_unguarded_site_and_unguarded_gated_callee_call(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, ZCO_FIXTURE), "zero-cost-off")
+        assert {(f.line, f.scope) for f in fs} == {
+            (4, "finish_bad"), (14, "caller_bad")}
+        assert "enable bool" in fs[0].message
+
+    def test_observability_package_itself_exempt(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            def record(kind):
+                from . import timeline
+                timeline.dispatch_span(kind, 0.0, 1.0)
+            """, relpath="paddle_tpu/observability/comms.py"),
+            "zero-cost-off")
+        assert fs == []
+
+    def test_pragma_disable_suppresses(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, """\
+            from .. import observability as _obs
+
+            def export(base):  # ptlint: disable=zero-cost-off
+                return _obs.timeline.chrome_events(base)
+            """), "zero-cost-off")
+        assert fs == []
+
+    def test_closure_inside_gate_is_gated(self, tmp_path):
+        # a nested def defined inside `if <gate>:` — or in a function
+        # that early-exited on disabled — only exists with the layer on
+        fs = _by_pass(_scan(tmp_path, """\
+            from .. import observability as _obs
+
+            def outer(req, c):
+                if _obs.enabled():
+                    def cb():
+                        _obs.timeline.request_event(req, "t", c())
+                    cb()
+
+            def early(req, c):
+                if not _obs.enabled():
+                    return
+                def cb():
+                    _obs.timeline.request_event(req, "t", c())
+                cb()
+
+            def leak(req, c):
+                def cb():
+                    _obs.timeline.request_event(req, "t", c())
+                cb()
+            """), "zero-cost-off")
+        assert [(f.line, f.scope) for f in fs] == [(18, "leak.cb")]
+
+    def test_closure_inside_gated_callee_body_exempt(self, tmp_path):
+        # a helper closure factored out inside a gated-callee body is
+        # part of that body — the callers own the gate, not the closure
+        fs = _by_pass(_scan(tmp_path, """\
+            from .. import observability as _obs
+
+            class S:
+                def _obs_dispatch(self, lanes):  # ptlint: gated-callee
+                    def span(i):
+                        return _obs.timeline.dispatch_span("d", i, i + 1)
+                    return [span(i) for i in lanes]
+            """), "zero-cost-off")
+        assert fs == []
+
+    def test_cross_module_gated_callee_call(self, tmp_path):
+        # `_traced_call` is a registry gated-callee of collective.py —
+        # importing it into ANOTHER module doesn't escape the contract
+        fs = _by_pass(_scan(tmp_path, """\
+            from .communication.collective import _traced_call
+            from .. import observability as _obs
+
+            def good(fn, args):
+                if _obs.enabled():
+                    return _traced_call("x", fn, args)
+                return fn(*args)
+
+            def bad(fn, args):
+                return _traced_call("x", fn, args)
+            """, relpath="paddle_tpu/distributed/other.py"),
+            "zero-cost-off")
+        assert [(f.line, f.scope, f.symbol) for f in fs] == [
+            (10, "bad", "_traced_call")]
+
+
+# ---------------------------------------------------------------------------
+# pass: lock-hygiene
+# ---------------------------------------------------------------------------
+
+LOCK_FIXTURE = """\
+    import threading
+    import time
+
+    _lock = threading.Lock()
+    _state = {}
+
+    def good(k, v):
+        with _lock:
+            _state[k] = v
+
+    def bad(k, v):
+        _state[k] = v
+
+    def sleepy():
+        with _lock:
+            time.sleep(1)
+
+    class Mgr:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._pending = []
+
+        def add(self, x):
+            with self._mu:
+                self._pending.append(x)
+
+        def steal(self):
+            self._pending.clear()
+
+        def wait(self, th):
+            with self._mu:
+                th.join()
+
+        def label(self, parts):
+            with self._mu:
+                return ",".join(parts)
+    """
+
+
+class TestLockHygiene:
+    def test_threaded_module_findings(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(reg, "THREADED_MODULES",
+                            reg.THREADED_MODULES + ("lock_fixture.py",))
+        fs = _by_pass(_scan(tmp_path, LOCK_FIXTURE,
+                            relpath="lock_fixture.py"), "lock-hygiene")
+        assert {(f.line, f.symbol) for f in fs} == {
+            (12, "unguarded-write:_state"),
+            (16, "blocking-under-lock:time.sleep"),
+            (28, "unguarded-write:self._pending"),
+            (32, "blocking-under-lock:join()")}
+        # __init__ writes and str.join under the lock are NOT findings
+
+    def test_not_a_threaded_module_no_findings(self, tmp_path):
+        fs = _by_pass(_scan(tmp_path, LOCK_FIXTURE,
+                            relpath="somewhere_else.py"), "lock-hygiene")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet semantics (library level)
+# ---------------------------------------------------------------------------
+
+class TestBaselineSemantics:
+    def _f(self, symbol, line=1, path="a.py"):
+        return Finding("hot-path", path, line, 0, "fn", symbol, "msg")
+
+    def test_new_baselined_and_count_semantics(self):
+        found = [self._f("print"), self._f("print", line=9)]
+        baseline = finding_counts([self._f("print")])
+        new, stale = compare_to_baseline(found, baseline, ["a.py"])
+        assert len(new) == 1 and new[0].line == 9 and stale == {}
+
+    def test_stale_entry_reported(self):
+        baseline = finding_counts([self._f("print")])
+        new, stale = compare_to_baseline([], baseline, ["a.py"])
+        assert new == [] and list(stale) == [self._f("print").key]
+
+    def test_partial_scan_never_stales_other_trees(self):
+        baseline = finding_counts([self._f("print", path="other/tree.py")])
+        new, stale = compare_to_baseline([], baseline,
+                                         scanned_files=["a.py"])
+        assert new == [] and stale == {}
+
+
+# ---------------------------------------------------------------------------
+# the CLI: exit-code contract + --json (subprocess, no jax)
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, PTLINT] + args,
+                          capture_output=True, text=True, cwd=cwd,
+                          timeout=120)
+
+
+class TestCLI:
+    @pytest.fixture
+    def fixture_tree(self, tmp_path):
+        d = tmp_path / "pkg"
+        d.mkdir()
+        (d / "hot.py").write_text(textwrap.dedent("""\
+            def sample(logits):   # ptlint: hot-path
+                print("per-call I/O")
+                return logits
+            """))
+        return d
+
+    def test_new_finding_exits_1_then_baseline_passes_then_stale_errors(
+            self, fixture_tree, tmp_path):
+        bl = str(tmp_path / "bl.json")
+        target = str(fixture_tree)
+        r = _cli([target, "--baseline", bl])
+        assert r.returncode == 1 and "hot-path" in r.stdout
+        # ratchet in: baselined finding passes
+        assert _cli([target, "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        r = _cli([target, "--baseline", bl])
+        assert r.returncode == 0, r.stdout + r.stderr
+        # fix the violation -> the stale baseline entry now errors
+        (fixture_tree / "hot.py").write_text(textwrap.dedent("""\
+            def sample(logits):   # ptlint: hot-path
+                return logits
+            """))
+        r = _cli([target, "--baseline", bl])
+        assert r.returncode == 1 and "STALE" in r.stdout
+        # shrinking the baseline restores the gate
+        assert _cli([target, "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        assert _cli([target, "--baseline", bl]).returncode == 0
+        assert json.load(open(bl))["findings"] == {}
+
+    def test_config_errors_exit_2(self, fixture_tree, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert _cli([str(fixture_tree), "--baseline",
+                     str(bad)]).returncode == 2
+        assert _cli(["no/such/dir"]).returncode == 2
+        assert _cli([str(fixture_tree),
+                     "--passes", "nonsense"]).returncode == 2
+        # --no-baseline disables the ratchet; rewriting it from such a
+        # run would wipe every other tree's entries
+        assert _cli([str(fixture_tree), "--no-baseline",
+                     "--update-baseline"]).returncode == 2
+        # tier-A scope args combined with --hlo-audit would be silently
+        # dropped — config error, not a misleading green
+        r = _cli([str(fixture_tree), "--hlo-audit"])
+        assert r.returncode == 2 and "ignored" in r.stderr
+        assert _cli(["--hlo-audit", "--passes", "hot-path"]).returncode == 2
+        # ...and the reverse: --manifest on a tier-A run would be
+        # silently unread
+        assert _cli([str(fixture_tree),
+                     "--manifest", "m.json"]).returncode == 2
+
+    def test_json_update_baseline_emits_object(self, fixture_tree, tmp_path):
+        bl = str(tmp_path / "bl.json")
+        r = _cli([str(fixture_tree), "--baseline", bl,
+                  "--update-baseline", "--json"])
+        assert r.returncode == 0
+        out = json.loads(r.stdout)
+        assert out["updated"] is True and out["entries"] == 1
+        assert out["findings"] == 1 and out["baseline"] == bl
+
+    def test_pass_filtered_update_preserves_other_passes(
+            self, fixture_tree, tmp_path):
+        """--passes X --update-baseline must not drop other passes'
+        baseline entries for the same files (ratchet corruption)."""
+        (fixture_tree / "both.py").write_text(textwrap.dedent("""\
+            from .. import observability as _obs
+
+            def hot(x):   # ptlint: hot-path
+                print(x)
+
+            def site(r, c):
+                _obs.timeline.request_event(r, "t", c())
+            """))
+        bl = str(tmp_path / "bl.json")
+        target = str(fixture_tree)
+        assert _cli([target, "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        before = json.load(open(bl))["findings"]
+        # hot.py print + both.py print (hot-path) + both.py request_event
+        assert len(before) == 3 and any(
+            k.startswith("zero-cost-off|") for k in before)
+        # re-update with only hot-path selected: zero-cost-off entry stays
+        assert _cli([target, "--baseline", bl, "--passes", "hot-path",
+                     "--update-baseline"]).returncode == 0
+        after = json.load(open(bl))["findings"]
+        assert after == before
+        assert _cli([target, "--baseline", bl]).returncode == 0
+
+    def test_pass_filtered_check_ignores_other_passes_entries(
+            self, fixture_tree, tmp_path):
+        """--passes X must not call another pass's baseline entries
+        stale: the unselected pass never ran, so its findings still
+        exist — only out of this run's scope."""
+        (fixture_tree / "both.py").write_text(textwrap.dedent("""\
+            from .. import observability as _obs
+
+            def site(r, c):
+                _obs.timeline.request_event(r, "t", c())
+            """))
+        bl = str(tmp_path / "bl.json")
+        target = str(fixture_tree)
+        assert _cli([target, "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        before = json.load(open(bl))["findings"]
+        assert any(k.startswith("zero-cost-off|") for k in before)
+        r = _cli([target, "--baseline", bl, "--passes", "hot-path"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "STALE" not in r.stdout
+        # the selected pass's own ratchet still holds: fix hot.py and
+        # the filtered run goes stale on ITS entry
+        (fixture_tree / "hot.py").write_text(textwrap.dedent("""\
+            def sample(logits):   # ptlint: hot-path
+                return logits
+            """))
+        r = _cli([target, "--baseline", bl, "--passes", "hot-path"])
+        assert r.returncode == 1 and "STALE" in r.stdout
+
+    def test_deleted_file_baseline_entry_goes_stale(self, tmp_path):
+        d = tmp_path / "pkg2"
+        d.mkdir()
+        f = d / "gone.py"
+        f.write_text(textwrap.dedent("""\
+            def hot(x):   # ptlint: hot-path
+                print(x)
+            """))
+        bl = str(tmp_path / "bl.json")
+        assert _cli([str(d), "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        f.unlink()
+        r = _cli([str(d), "--baseline", bl])
+        assert r.returncode == 1 and "STALE" in r.stdout
+        # the deletion is scoped like everything else: a run over a
+        # DIFFERENT tree, or with the entry's pass unselected, must not
+        # fail on it
+        other = tmp_path / "pkg3"
+        other.mkdir()
+        (other / "clean.py").write_text("x = 1\n")
+        assert _cli([str(other), "--baseline", bl]).returncode == 0
+        assert _cli([str(d), "--baseline", bl,
+                     "--passes", "lock-hygiene"]).returncode == 0
+        # update drops the dead entry
+        assert _cli([str(d), "--baseline", bl,
+                     "--update-baseline"]).returncode == 0
+        assert json.load(open(bl))["findings"] == {}
+
+    def test_json_output_contract(self, fixture_tree, tmp_path):
+        r = _cli([str(fixture_tree), "--baseline",
+                  str(tmp_path / "bl.json"), "--json"])
+        assert r.returncode == 1
+        out = json.loads(r.stdout)
+        assert out["ok"] is False and out["files_scanned"] == 1
+        (entry,) = out["new"]
+        assert entry["pass"] == "hot-path" and entry["line"] == 2
+        assert entry["key"].startswith("hot-path|")
+        assert out["by_pass"] == {"hot-path": 1}
+
+
+# ---------------------------------------------------------------------------
+# the committed repo gate (the tier-1 rider) — pure AST, no jax import
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_serving_and_inference_clean_without_jax(self):
+        """The fast tier-1 gate: tier A over serving/ + inference/ with
+        the COMMITTED baseline passes, and the run never imports jax
+        (the whole point of the standalone loader)."""
+        code = textwrap.dedent("""\
+            import sys
+            sys.path.insert(0, %r)
+            import ptlint
+            rc = ptlint.main(["paddle_tpu/serving", "paddle_tpu/inference",
+                              "paddle_tpu/analysis"])
+            assert "jax" not in sys.modules, "tier A must not import jax"
+            assert "paddle_tpu" not in sys.modules, \\
+                "tier A must not import the package"
+            sys.exit(rc)
+            """) % os.path.join(REPO, "tools")
+        r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    @pytest.mark.slow
+    def test_whole_repo_clean_with_committed_baseline(self):
+        # smoke-tier twin of the scoped tier-1 gate above: the full
+        # 252-file scan costs ~5 s — real tier-1 budget on the 870 s
+        # box — and the scoped run already proves gate + no-jax
+        r = _cli([])
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# tier B: HLO audit
+# ---------------------------------------------------------------------------
+
+SYNTHETIC_HLO = """\
+HloModule synthetic
+
+ENTRY %main (p0: f32[4,8], p1: f32[8,4]) -> f32[4,4] {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %p1 = f32[8,4]{1,0} parameter(1)
+  %d = f32[4,4]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}
+  %ar = f32[16]{0} all-reduce(%d), replica_groups={}
+  %tok = token[] after-all()
+  %of = token[] outfeed(%d, %tok)
+  ROOT %r = f32[4,4]{1,0} add(%d, %d)
+}
+"""
+
+
+class TestHLOAudit:
+    def test_text_censuses(self):
+        assert host_transfer_census(SYNTHETIC_HLO) == 1          # outfeed
+        assert dtype_gemm_census(SYNTHETIC_HLO) == {"f32": 1}
+
+    def test_doctored_manifest_directions(self):
+        # honest budgets: only the genuinely-present violations fire
+        actuals, findings = audit_text(SYNTHETIC_HLO, {
+            "host_transfer_ops_max": 1, "collective_ops_max": 1,
+            "declared_dtype": "f32"})
+        assert findings == [] and actuals["collective_ops"] == 1
+        # doctored: zero budgets + bf16 claim + op budget all fail
+        _actuals, findings = audit_text(SYNTHETIC_HLO, {
+            "host_transfer_ops_max": 0, "collective_ops_max": 0,
+            "declared_dtype": "bf16", "op_budget": {"dot": 0}})
+        kinds = "\n".join(findings)
+        assert len(findings) == 4
+        assert "host_transfer_ops 1 > budget 0" in kinds
+        assert "collective_ops 1 > budget 0" in kinds
+        assert "f32 gemm" in kinds and "op_budget: dot" in kinds
+
+    def test_unknown_manifest_key_is_config_error(self):
+        with pytest.raises(ManifestError):
+            audit_text(SYNTHETIC_HLO, {"host_transfers_max": 0})
+
+    def test_host_callback_custom_call_counted(self):
+        # io_callback/pure_callback/debug.print compile to a
+        # "*callback*" custom-call — a host round-trip per call
+        hlo = ('ENTRY %m {\n  %cc = () custom-call(%x), '
+               'custom_call_target="xla_python_cpu_callback"\n}\n')
+        assert host_transfer_census(hlo) == 1
+        import jax
+
+        def f(x):
+            jax.debug.print("x {}", x)
+            return x + 1
+
+        text = jax.jit(f).lower(1.0).compile().as_text()
+        assert host_transfer_census(text) >= 1
+
+    def test_malformed_manifest_entry_is_config_error(self, tmp_path):
+        """A non-dict entry or unknown key is a CONFIG error (exit 2)
+        raised at load time — BEFORE any executable is lowered — not a
+        TypeError misread as a manifest violation."""
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps(
+            {"version": 1, "executables": {"sampler": None}}))
+        with pytest.raises(ManifestError, match="constraints object"):
+            load_manifest(str(p))
+        p.write_text(json.dumps(
+            {"version": 1,
+             "executables": {"sampler": {"host_transfers_max": 0}}}))
+        with pytest.raises(ManifestError, match="unknown key"):
+            load_manifest(str(p))
+        # value TYPES validated too — a typo'd budget must not become
+        # a TypeError after paying for the lowering
+        p.write_text(json.dumps(
+            {"version": 1,
+             "executables": {"sampler": {"host_transfer_ops_max": "zero"}}}))
+        with pytest.raises(ManifestError, match="integer"):
+            load_manifest(str(p))
+        p.write_text(json.dumps(
+            {"version": 1,
+             "executables": {"sampler": {"op_budget": {"dot": "none"}}}}))
+        with pytest.raises(ManifestError, match="op_budget"):
+            load_manifest(str(p))
+
+    def test_ragged_decode_lowering_proves_zero_host_transfers(self):
+        """The acceptance check: lower the REAL ragged decode executable
+        and prove the compiled artifact moves nothing across the host
+        boundary — then show a doctored manifest fails it."""
+        from paddle_tpu.analysis.hlo_audit import lower_executable
+
+        text = lower_executable("ragged_decode")
+        assert host_transfer_census(text) == 0
+        actuals, findings = audit_text(
+            text, {"host_transfer_ops_max": 0, "collective_ops_max": 0,
+                   "declared_dtype": "f32"})
+        assert findings == [] and actuals["host_transfer_ops"] == 0
+        # doctored: demand an op mix the program doesn't have
+        _a, findings = audit_text(text, {"op_budget": {"dot": 0}})
+        assert findings and "op_budget: dot" in findings[0]
+
+    def test_run_audit_against_committed_manifest(self):
+        from paddle_tpu.analysis.hlo_audit import run_audit
+
+        report = run_audit(only=["sampler"])
+        assert report["ok"] is True
+        entry = report["executables"]["sampler"]
+        assert entry["host_transfer_ops"] == 0
+        assert entry["collective_ops"] == 0

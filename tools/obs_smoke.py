@@ -9,8 +9,12 @@ profiler on, covering a preemption (tight KV pool) and an injected
    dispatch track, a preemption marker, and the injected-fault marker;
 2. the flight recorder dumped a `flight_*.jsonl` on the injected fault,
    and the dump replays the rounds leading up to it;
-3. retrace causes were attributed (the prefill bucket family compiles
-   with named shape diffs) and per-executable CostCards exist;
+3. retrace causes were attributed and per-executable CostCards exist.
+   The ragged world (PR 9) performs ZERO steady-state retraces — the
+   bucket family this smoke used to lean on is gone — so a retrace is
+   PROVOKED: a second frontend with a different `prefill_chunk_tokens`
+   changes the packed-token shape T, and the cause names the changed
+   field ("arg0 shape (35,) -> (19,)");
 4. `tools/bench_diff.py` PASSES on a self-baseline and FAILS (exit 1) on
    a doctored 10 % regression against the same baseline.
 
@@ -120,6 +124,18 @@ def serving_trace(tmp):
         "flight dump lost the pre-fault lifecycle"
 
     # ---- retrace causes + cost cards ----
+    # PR 9 collapsed the prefill bucket family into ONE fixed-shape
+    # ragged executable: the trace above (rightly) retraces nothing, so
+    # the attribution machinery is exercised by PROVOKING a retrace — a
+    # second frontend with a smaller chunk budget dispatches serve.decode
+    # at a different packed-token shape T, and the cause must name it
+    fe2 = ServingFrontend(
+        MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=3,
+                    num_blocks=14, block_size=4, max_blocks_per_seq=8),
+        prefill_chunk_tokens=16)
+    h2 = fe2.submit(rng.integers(1, 64, 5).tolist(), max_new_tokens=2)
+    fe2.run_until_idle(max_steps=500)
+    assert h2.status is RequestStatus.FINISHED, h2.status
     causes = obs.retrace_causes()
     assert any("shape" in c["cause"] for c in causes), causes
     rows = {r["name"]: r for r in obs.cost_book().rows()}

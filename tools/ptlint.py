@@ -1,0 +1,278 @@
+"""ptlint — the framework-native static-analysis gate (ISSUE 13).
+
+Tier A (default): five AST passes over the package — use-after-donate,
+trace-hazard, hot-path discipline, zero-cost-off, lock/thread hygiene —
+ratcheted by the committed ``ptlint_baseline.json``: a finding already
+in the baseline passes, a NEW finding fails, and a FIXED finding's stale
+baseline entry also fails until the baseline shrinks (the suppression
+file can only ratchet toward empty).
+
+Tier B (``--hlo-audit``): lowers the registered bench executables and
+checks the compiled HLO against ``paddle_tpu/analysis/hlo_manifest.json``
+— collective budgets, zero host-transfer ops on the decode path, dtype
+discipline. Needs jax; everything else here is STDLIB-ONLY and loads
+``paddle_tpu/analysis`` standalone (no paddle_tpu / jax import — same
+trick as tools/bench_diff.py), so the tier-A gate costs a few seconds
+of pure parsing on any box (repo-wide: ~5 s on a loaded 2-core CI
+container, no interpreter/jax startup on top).
+
+Usage:
+    python tools/ptlint.py                        # whole package, gated
+    python tools/ptlint.py paddle_tpu/serving paddle_tpu/inference
+    python tools/ptlint.py --json                 # machine output
+    python tools/ptlint.py --update-baseline      # rewrite the ratchet
+    python tools/ptlint.py --no-baseline          # raw findings, exit 1 if any
+    python tools/ptlint.py --hlo-audit            # tier B (imports jax)
+
+Exit codes (bench_diff.py conventions): 0 clean, 1 new/stale findings
+(or HLO manifest violation), 2 config error (bad baseline/manifest,
+unknown target, unknown pass).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(_REPO, "ptlint_baseline.json")
+_DEFAULT_TARGETS = ["paddle_tpu"]
+
+
+def _load_analysis():
+    """Load paddle_tpu/analysis as a standalone package — importing
+    `paddle_tpu` proper would pull jax, which tier A must never do."""
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "analysis")
+    name = "_pt_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="framework-native static analysis (tier A: AST "
+                    "passes; tier B: compiled-HLO audit)")
+    ap.add_argument("targets", nargs="*", default=None,
+                    help="files/dirs relative to the repo root "
+                         "(default: paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON object on stdout (findings, new, "
+                         "stale, counts)")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="ratchet baseline path (default "
+                         "ptlint_baseline.json at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding, "
+                         "exit 1 if any")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings "
+                         "(scanned paths only; other trees' entries are "
+                         "kept)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--hlo-audit", action="store_true",
+                    help="run tier B: lower registered executables and "
+                         "check the committed HLO manifest (imports jax)")
+    ap.add_argument("--manifest", default=None,
+                    help="HLO manifest path (default "
+                         "paddle_tpu/analysis/hlo_manifest.json)")
+    args = ap.parse_args(argv)
+
+    an = _load_analysis()
+
+    if args.manifest and not args.hlo_audit:
+        print("ptlint: --manifest only applies to --hlo-audit (a tier-A "
+              "run never reads it — this would be a misleading green)",
+              file=sys.stderr)
+        return 2
+    if args.hlo_audit:
+        # tier B audits the manifest's executables — a tier-A scope
+        # would be silently dropped, so combining them is a config error
+        dropped = []
+        if args.targets:
+            dropped.append("targets")
+        for flag in ("passes", "no_baseline", "update_baseline"):
+            if getattr(args, flag):
+                dropped.append("--" + flag.replace("_", "-"))
+        if args.baseline != _DEFAULT_BASELINE:
+            dropped.append("--baseline")
+        if dropped:
+            print(f"ptlint: --hlo-audit audits the manifest's "
+                  f"executables; {', '.join(dropped)} would be ignored "
+                  "(scope tier B via --manifest / the manifest file)",
+                  file=sys.stderr)
+            return 2
+        return _run_hlo_audit(args)
+
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = set(passes) - set(an.PASS_IDS)
+        if unknown:
+            print(f"ptlint: unknown pass(es): {sorted(unknown)} "
+                  f"(have: {list(an.PASS_IDS)})", file=sys.stderr)
+            return 2
+    targets = args.targets or _DEFAULT_TARGETS
+    try:
+        findings, scanned = an.scan_paths(_REPO, targets, passes)
+    except FileNotFoundError as e:
+        print(f"ptlint: {e}", file=sys.stderr)
+        return 2
+    parse_errors = [f for f in findings if f.pass_id == "parse-error"]
+    if parse_errors:
+        for f in parse_errors:
+            print(f.render(), file=sys.stderr)
+        return 2
+
+    if args.no_baseline and args.update_baseline:
+        print("ptlint: --no-baseline and --update-baseline are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+    if args.no_baseline:
+        baseline = {}
+        new, stale = findings, {}
+    else:
+        try:
+            baseline = (an.load_baseline(args.baseline)
+                        if os.path.exists(args.baseline) else {})
+        except an.BaselineError as e:
+            print(f"ptlint: {e}", file=sys.stderr)
+            return 2
+        in_scope = baseline
+        if passes is not None:
+            # a --passes-filtered run produces no findings for the other
+            # passes — their baseline entries are out of scope, not stale
+            sel = set(passes)
+            in_scope = {k: v for k, v in baseline.items()
+                        if an.baseline_pass(k) in sel}
+        new, stale = an.compare_to_baseline(findings, in_scope, scanned)
+        # an entry for a file that no longer exists is stale — deleted/
+        # renamed files must not leave immortal suppressions (the
+        # scanned-files filter can't see them, by construction). Scoped
+        # like everything else: selected passes only (in_scope) and
+        # files under the scanned targets — a serving/-lane run must not
+        # fail on a deletion elsewhere in the repo.
+        roots = []
+        for t in targets:
+            rel = os.path.relpath(os.path.abspath(os.path.join(_REPO, t)),
+                                  _REPO).replace(os.sep, "/")
+            roots.append(rel.rstrip("/"))
+        for k, v in in_scope.items():
+            rel = an.baseline_file(k)
+            if rel and not os.path.exists(os.path.join(_REPO, rel)) \
+                    and any(rel == r or rel.startswith(r + "/")
+                            for r in roots):
+                stale.setdefault(k, v)
+
+    if args.update_baseline:
+        # keep entries OUTSIDE this run's scope — files not scanned, or
+        # passes not selected — so a subtree or single-pass run never
+        # wipes the rest of the ratchet; entries for deleted files drop
+        scanned_set = set(scanned)
+        selected = set(passes) if passes is not None else None
+        kept = {}
+        for k, v in baseline.items():
+            rel = an.baseline_file(k)
+            if rel and not os.path.exists(os.path.join(_REPO, rel)):
+                continue
+            if rel not in scanned_set or (
+                    selected is not None
+                    and an.baseline_pass(k) not in selected):
+                kept[k] = v
+        counts = an.finding_counts(findings)
+        merged = {**kept, **counts}
+        an.save_baseline_counts(args.baseline, merged)
+        if args.as_json:
+            print(json.dumps({
+                "updated": True, "baseline": args.baseline,
+                "entries": len(merged),
+                "findings": sum(merged.values()),
+            }, indent=1))
+        print(f"ptlint: baseline updated: {len(merged)} entries "
+              f"({sum(merged.values())} findings) -> {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "targets": targets,
+            "files_scanned": len(scanned),
+            "findings_total": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": [f.as_dict() for f in new],
+            "stale_baseline_entries": stale,
+            "by_pass": _by_pass(findings),
+            "ok": not new and not stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for key, n in sorted(stale.items()):
+            print(f"STALE baseline entry ({n} no longer found): {key}")
+        print(f"ptlint: {len(scanned)} files, {len(findings)} findings "
+              f"({len(findings) - len(new)} baselined, {len(new)} new, "
+              f"{len(stale)} stale baseline entries)", file=sys.stderr)
+    if new:
+        print("ptlint: FAIL — new findings (fix them, or extend "
+              "ptlint_baseline.json deliberately via --update-baseline)",
+              file=sys.stderr)
+        return 1
+    if stale:
+        print("ptlint: FAIL — stale baseline entries (findings were "
+              "fixed: shrink the baseline via --update-baseline so the "
+              "ratchet holds)", file=sys.stderr)
+        return 1
+    print("ptlint: PASS", file=sys.stderr)
+    return 0
+
+
+def _by_pass(findings) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.pass_id] = out.get(f.pass_id, 0) + 1
+    return out
+
+
+def _run_hlo_audit(args) -> int:
+    """Tier B rides the real package (it must build engines), so jax
+    loads here — and only here."""
+    sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.analysis import hlo_audit
+
+    manifest_path = args.manifest or hlo_audit.DEFAULT_MANIFEST
+    try:
+        report = hlo_audit.run_audit(manifest_path)
+    except hlo_audit.ManifestError as e:
+        print(f"ptlint: hlo-audit config error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for name, entry in report["executables"].items():
+            status = "FAIL" if entry["findings"] else "ok"
+            print(f"hlo-audit {name}: {status} "
+                  f"(host_transfer_ops={entry['host_transfer_ops']}, "
+                  f"collectives={entry['collective_ops']}, "
+                  f"f32_gemms={entry['f32_gemms']})")
+            for f in entry["findings"]:
+                print(f"  - {f}")
+    if not report["ok"]:
+        print("ptlint: hlo-audit FAIL — compiled artifact violates the "
+              "committed manifest", file=sys.stderr)
+        return 1
+    print("ptlint: hlo-audit PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
