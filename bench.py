@@ -1272,6 +1272,195 @@ def serving_shared_prefix_main():
     }, "serving_shared_prefix")
 
 
+@scenario("serving_quant", 420)
+def serving_quant_main():
+    """`python bench.py serving_quant` — the quantized-serving capacity
+    instrument (ROADMAP item 4, ISSUE 14): int8 weight-only gemms +
+    int8 paged KV (per-slot scale planes, quantize-on-write, in-kernel
+    dequant) against the full-precision stack.
+
+    The capacity contract: size the quantized pool at the SAME KV HBM
+    byte budget as the baseline (`bytes_per_block` halves-or-better, so
+    the block count roughly doubles) and drive an identical closed-loop
+    burst — the quantized stack must admit >= 2x the concurrent
+    sequences (>= 1.7x on TPU, where the bf16 baseline is already half
+    of f32 and the scale planes' overhead is honestly counted) with
+    tok/s and TTFT p99 no worse than the baseline at its 1x
+    concurrency. Also asserted in-run: teacher-forced greedy top-1
+    agreement >= 99 % (tie-aware, `serving.quant.greedy_agreement`),
+    spec==plain token parity ON the quantized stack, zero ragged/sample
+    retraces after warmup, zero leaked blocks + pool consistency.
+    Gated via BaselineStore/bench_diff on tok/s, the concurrency ratio,
+    and TTFT p99. Run SOLO outside the tier-1 window (ROADMAP note)."""
+    probe = _scenario_setup("serving_quant")
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.inference import LlamaInferenceEngine
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import (NGramProposer, RequestStatus,
+                                    ServingFrontend, ServingMetrics,
+                                    SpecDecodeConfig, greedy_agreement,
+                                    quantize_engine)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    model = llama_tiny(vocab=128, layers=2, hidden=64, heads=4, seq=256)
+    model.eval()
+    rng = np.random.default_rng(0)
+    n_requests = int(os.environ.get("BENCH_QUANT_REQUESTS", "24"))
+    lanes, base_blocks, bs = 16, 24, 8
+    prompts = [rng.integers(1, 128, 24).tolist() for _ in range(n_requests)]
+
+    def build(kv_bits=16, wbits=None, num_blocks=base_blocks):
+        eng = LlamaInferenceEngine(
+            model, max_batch_size=lanes, num_blocks=num_blocks,
+            block_size=bs, max_blocks_per_seq=8, kv_bits=kv_bits,
+            **({"dtype": "bfloat16"} if on_tpu else {}))
+        if wbits is not None:
+            quantize_engine(eng, wbits)
+        return eng
+
+    # equal KV HBM bytes: the quantized pool gets however many blocks
+    # the baseline's byte budget buys at its (smaller) bytes_per_block —
+    # the 2x-sequences-per-HBM-byte claim, with the scale planes'
+    # overhead counted against it. kv_quant.kv_bytes_per_block owns the
+    # formula (the engines register the SAME numbers on their managers,
+    # which run_burst reads back for the report/audit)
+    from paddle_tpu.inference import kv_quant
+
+    mcfg = model.config
+    geom = dict(kv_heads=mcfg.num_key_value_heads, block_size=bs,
+                head_dim=mcfg.head_dim, dtype_bytes=2 if on_tpu else 4,
+                num_layers=mcfg.num_hidden_layers)
+    bpb_base = kv_quant.kv_bytes_per_block(kv_bits=16, **geom)
+    bpb_q = kv_quant.kv_bytes_per_block(kv_bits=8, **geom)
+    quant_blocks = (base_blocks * bpb_base) // bpb_q
+
+    def run_burst(engine):
+        ServingMetrics.reset_monitor()
+        fe = ServingFrontend(engine, prefill_chunk_tokens=32)
+        for n in (3, 17):      # warm the ragged executable + sampler
+            fe.submit(rng.integers(1, 128, n).tolist(), max_new_tokens=2)
+        fe.run_until_idle(max_steps=500)
+        monitor.reset("serving.ragged_retraces")
+        monitor.reset("serving.sample_retraces")
+        fe.metrics.reset_window()
+        base_tokens = monitor.get("serving.tokens_generated")
+        handles = [fe.submit(p, max_new_tokens=8) for p in prompts]
+        peak = 0
+        t0 = time.perf_counter()
+        while not fe.scheduler.idle:
+            fe.step()
+            peak = max(peak, fe.scheduler.num_running)
+        wall = time.perf_counter() - t0
+        done = sum(h.status is RequestStatus.FINISHED for h in handles)
+        tokens = monitor.get("serving.tokens_generated") - base_tokens \
+            + done  # + the prefill-sampled first tokens
+        ttfts = sorted(t for t in (h.ttft_ms() for h in handles)
+                       if t is not None)
+        mgr = fe.scheduler.engine.manager
+        leaked = fe.scheduler.kv_leaked_blocks()
+        mgr.check_consistency()
+        return {
+            "tok_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 2),
+            "completed": done,
+            "peak_concurrency": peak,
+            "ttft_p99_ms": round(float(np.percentile(
+                np.asarray(ttfts), 99)), 3),
+            "ttft_p50_ms": round(float(np.percentile(
+                np.asarray(ttfts), 50)), 3),
+            "ragged_retraces": monitor.get("serving.ragged_retraces"),
+            "sample_retraces": monitor.get("serving.sample_retraces"),
+            "leaked_blocks": leaked,
+            "num_blocks": mgr.num_blocks,
+            "bytes_per_block": mgr.bytes_per_block,
+            "pool_bytes": mgr.bytes_per_block * mgr.num_blocks,
+            "kv_bits": mgr.kv_bits,
+            "preemptions": monitor.get("serving.preemptions"),
+        }, [h.tokens for h in handles]
+
+    base, _ = run_burst(build())
+    quant, _ = run_burst(build(kv_bits=8, wbits=8, num_blocks=quant_blocks))
+
+    # spec==plain parity ON the quantized stack: same engine config,
+    # speculative vs plain decode, bitwise token streams
+    def run_tokens(spec):
+        fe = ServingFrontend(
+            build(kv_bits=8, wbits=8, num_blocks=quant_blocks),
+            spec=SpecDecodeConfig(NGramProposer(), num_draft_tokens=3)
+            if spec else None)
+        hs = [fe.submit(p, max_new_tokens=8) for p in prompts[:8]]
+        fe.run_until_idle(max_steps=2000)
+        assert all(h.status is RequestStatus.FINISHED for h in hs)
+        return [h.tokens for h in hs]
+
+    spec_toks = run_tokens(spec=True)
+    plain_toks = run_tokens(spec=False)
+
+    # teacher-forced greedy agreement, quantized vs full precision
+    agreement = greedy_agreement(
+        build(kv_bits=8, wbits=8), build(), prompts[:8])
+
+    concurrency_x = round(quant["peak_concurrency"]
+                          / max(base["peak_concurrency"], 1), 2)
+    tok_s_x = round(quant["tok_s"] / base["tok_s"], 2)
+    ttft_p99_x = round(quant["ttft_p99_ms"] / base["ttft_p99_ms"], 2)
+
+    # hard in-run checks: the acceptance contract (ISSUE 14)
+    assert base["completed"] == n_requests and \
+        quant["completed"] == n_requests, (base, quant)
+    # the formula this scenario sized pools with IS what the engines
+    # registered on their managers (one source: kv_bytes_per_block)
+    assert base["bytes_per_block"] == bpb_base and \
+        quant["bytes_per_block"] == bpb_q, (base, quant, bpb_base, bpb_q)
+    assert quant["pool_bytes"] <= base["pool_bytes"], (quant, base)
+    conc_bar = 1.7 if on_tpu else 2.0
+    assert concurrency_x >= conc_bar, \
+        f"admitted concurrency {concurrency_x}x < {conc_bar}x " \
+        f"(quant peak {quant['peak_concurrency']} vs base " \
+        f"{base['peak_concurrency']} at equal pool bytes)"
+    assert tok_s_x >= 0.95, \
+        f"quantized tok/s {quant['tok_s']} < 0.95x baseline {base['tok_s']}"
+    assert ttft_p99_x <= 1.1, \
+        f"quantized TTFT p99 {quant['ttft_p99_ms']} ms worse than " \
+        f"1.1x baseline {base['ttft_p99_ms']} ms"
+    assert agreement["agreement_tie_aware"] >= 0.99, agreement
+    assert spec_toks == plain_toks, \
+        "spec==plain token parity broke under quantization"
+    assert quant["ragged_retraces"] == 0 and \
+        quant["sample_retraces"] == 0, quant
+    assert quant["leaked_blocks"] == 0 and base["leaked_blocks"] == 0
+
+    extras = {
+        "requests": n_requests,
+        "lanes": lanes,
+        "base": base,
+        "quant": quant,
+        "concurrency_x": concurrency_x,
+        "tok_s_x": tok_s_x,
+        "ttft_p99_ms": quant["ttft_p99_ms"],
+        "ttft_p99_x": ttft_p99_x,
+        "agreement": {k: round(v, 4) for k, v in agreement.items()},
+        "spec_plain_parity": True,
+        "quant_mode": {"wbits": 8, "kv_bits": 8},
+        "probe": probe,
+        "device": jax.devices()[0].device_kind or "cpu",
+    }
+    _emit_report({
+        "metric": "serving_quant_tok_s",
+        "value": quant["tok_s"],
+        "unit": f"tok/s int8(w)+int8(KV) at {concurrency_x}x admitted "
+                f"concurrency, equal pool bytes (TTFT p99 "
+                f"{quant['ttft_p99_ms']} ms = {ttft_p99_x}x base; "
+                f"tie-aware agreement "
+                f"{extras['agreement']['agreement_tie_aware']})",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "serving_quant")
+
+
 @scenario("serving_fleet", 420)
 def serving_fleet_main():
     """`python bench.py serving_fleet` — the multi-replica ROUTER scaling

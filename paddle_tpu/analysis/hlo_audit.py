@@ -118,10 +118,19 @@ def dtype_gemm_census(hlo_text: str) -> Dict[str, int]:
     return out
 
 
-def audit_text(hlo_text: str, entry: dict) -> Tuple[dict, List[str]]:
+def audit_text(hlo_text: str, entry: dict,
+               platform: Optional[str] = None) -> Tuple[dict, List[str]]:
     """Check one compiled program's text against one manifest entry.
     Returns (actuals, findings). Pure — the doctored-manifest tests and
-    any offline HLO dump ride this directly."""
+    any offline HLO dump ride this directly.
+
+    `platform`: the backend the text was compiled FOR. The
+    ``declared_dtype: bf16`` upcast scan only binds on ``"tpu"`` (or
+    ``None`` = caller-audited text, the strict default): CPU/GPU
+    legalization rewrites every bf16 dot to f32 regardless of the
+    program, so off-TPU the scan has no signal and is recorded as
+    skipped instead of failing a contract the platform cannot
+    satisfy."""
     unknown = set(entry) - _KNOWN_KEYS
     if unknown:
         raise ManifestError(f"unknown manifest key(s): {sorted(unknown)} "
@@ -156,7 +165,11 @@ def audit_text(hlo_text: str, entry: dict) -> Tuple[dict, List[str]]:
             "the program's comm profile changed; re-budget the manifest "
             "deliberately if the sharding change is intentional")
     declared = entry.get("declared_dtype")
-    if declared == "bf16" and gemms.get("f32", 0) > 0:
+    if declared == "bf16" and platform not in (None, "tpu"):
+        actuals["declared_dtype_check"] = (
+            f"skipped on {platform}: bf16 gemms legalize to f32 off-TPU, "
+            "so the upcast scan only binds on tpu")
+    elif declared == "bf16" and gemms.get("f32", 0) > 0:
         findings.append(
             f"declared-bf16 program compiles {gemms['f32']} f32 gemm(s) "
             "— a silent upcast (double gemm bytes, half MXU rate)")
@@ -223,6 +236,53 @@ def _exe_sampler():
                       np.zeros((B,), np.int32))
 
 
+def _exe_ragged_decode_quant():
+    """The QUANTIZED serving decode program (PR 14): `MLPLMEngine` with
+    an int8 KV pool (`kv_bits=8`) and int8 weight-only gemms
+    (`serving.quant.quantize_engine`), at the same packed shapes as
+    `ragged_decode`. Its compiled form must stay as host-transfer-free
+    and collective-free as the full-precision twin — quantize-on-write,
+    in-kernel dequant, and the dequant-fused weight gemms are all
+    device-side by construction, and this entry keeps them that way."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+    from ..serving.quant import quantize_engine
+
+    eng = quantize_engine(
+        MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                    num_blocks=16, block_size=4, max_blocks_per_seq=4,
+                    kv_bits=8), wbits=8)
+    B, T = 4, 4 + 8                       # max_batch + chunk budget
+    tokens = np.zeros((T,), np.int32)
+    q_lens = np.array([1, 1, 2, 0], np.int32)
+    kv_lens = np.array([3, 1, 2, 0], np.int32)
+    tables = np.zeros((B, 4), np.int32)
+    return eng._ragged, (eng.params, eng.cache, eng.cache_scale, tokens,
+                         q_lens, kv_lens, tables)
+
+
+def _exe_quant_matmul():
+    """The weight-only dequant gemm (`nn.quant.dequant_matmul`) at an
+    aligned bf16 x int8 shape — the executable every quantized engine's
+    projection matmuls route through. The audit pins zero host
+    transfers and no f32 gemm under the declared bf16 activations (the
+    int8->bf16 convert must fuse into the dot, not upcast it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..nn.quant import dequant_matmul
+
+    rng = np.random.default_rng(0)
+    M, K, N = 8, 128, 128
+    x = jnp.asarray(rng.normal(0, 1, (M, K)), jnp.bfloat16)
+    wq = jnp.asarray(rng.integers(-127, 128, (N, K)), jnp.int8)
+    scale = jnp.asarray(rng.uniform(0.01, 0.1, (N,)), jnp.float32)
+    return jax.jit(lambda a, w, s: dequant_matmul(a, w, s)), \
+        (x, wq, scale)
+
+
 def _exe_train_step():
     """A fused fwd+grad+update train step with DONATED state — the
     optimizer.py shape (jit(step, donate_argnums=...)), self-contained
@@ -257,6 +317,8 @@ def _exe_train_step():
 
 EXECUTABLES = {
     "ragged_decode": _exe_ragged_decode,
+    "ragged_decode_quant": _exe_ragged_decode_quant,
+    "quant_matmul": _exe_quant_matmul,
     "verify": _exe_verify,
     "sampler": _exe_sampler,
     "train_step": _exe_train_step,
@@ -339,7 +401,8 @@ def run_audit(manifest_path: Optional[str] = None,
         if name not in entries:
             raise ManifestError(f"executable {name!r} not in manifest")
         text = lower_executable(name)
-        actuals, findings = audit_text(text, entries[name])
+        actuals, findings = audit_text(text, entries[name],
+                                       platform=report["platform"])
         actuals["findings"] = findings
         report["executables"][name] = actuals
         if findings:

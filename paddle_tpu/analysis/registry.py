@@ -49,6 +49,12 @@ HOT_PATHS = {
     ("inference/llama_runner.py", "LlamaInferenceEngine.verify_step"),
     ("ops/sampling.py", "sample_tokens"),
     ("inference/cache.py", "BlockCacheManager.append_tokens"),
+    # the COW block-copy hooks run mid-decode under prefix sharing, and
+    # PR 14's quantized pools extend them to move int8 blocks + scale
+    # planes in one donated executable — still one dispatch, no per-call
+    # host conversions allowed
+    ("serving/engine.py", "MLPLMEngine.copy_kv_block"),
+    ("inference/llama_runner.py", "LlamaInferenceEngine.copy_kv_block"),
 }
 
 # ---------------------------------------------------------------------------

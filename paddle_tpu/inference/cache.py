@@ -113,6 +113,13 @@ class BlockCacheManager:
         self._cow_hook: Optional[Callable[[int, int], None]] = None
         self._reclaimer = None
         self.cow_copies = 0            # lifetime COW count (this manager)
+        # KV byte geometry (engines register it via `set_kv_geometry`):
+        # what one block costs in HBM and at how many bits per KV
+        # element — fragmentation() and the OOM forensics dumps report
+        # it so capacity claims (int8 KV => ~2x blocks per HBM byte)
+        # are auditable from telemetry, not inferred from configs
+        self._bytes_per_block: Optional[int] = None
+        self._kv_bits: int = 16
         # memory observability registry (weak; same sys.modules guard
         # pattern as _chaos — processes that never import observability
         # pay one dict lookup at construction, nothing per op)
@@ -178,6 +185,23 @@ class BlockCacheManager:
     def set_cow_hook(self, hook: Optional[Callable[[int, int], None]]):
         """`hook(src_block, dst_block)` copies device KV on COW."""
         self._cow_hook = hook
+
+    def set_kv_geometry(self, bytes_per_block: int,
+                        kv_bits: int = 16) -> None:
+        """Register the device-side byte cost of one pool block (across
+        K+V, all layers, INCLUDING any quantization scale planes) and
+        the KV element width. Engines call this at construction
+        (`inference/kv_quant.kv_bytes_per_block` owns the formula)."""
+        self._bytes_per_block = int(bytes_per_block)
+        self._kv_bits = int(kv_bits)
+
+    @property
+    def kv_bits(self) -> int:
+        return self._kv_bits
+
+    @property
+    def bytes_per_block(self) -> Optional[int]:
+        return self._bytes_per_block
 
     def set_reclaimer(self, reclaimer) -> None:
         """Register the cache-eviction authority: an object with
@@ -275,9 +299,19 @@ class BlockCacheManager:
             tokens += self._lens[sid]
         leased = len(physical)
         capacity_tokens = leased * self.block_size
+        bpb = self._bytes_per_block
         return {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
+            # byte-auditable capacity (None until an engine registers
+            # its geometry): pool/leased bytes derive from the SAME
+            # bytes_per_block the engine allocated with, so the int8-KV
+            # "2x sequences per HBM byte" claim reads straight off the
+            # fragmentation snapshot and every OOM forensics dump
+            "kv_bits": self._kv_bits,
+            "bytes_per_block": bpb,
+            "pool_bytes": bpb * self.num_blocks if bpb else None,
+            "leased_bytes": bpb * leased if bpb else None,
             "free_blocks": len(free),
             "guard_blocks": guard,
             "leased_blocks": leased,

@@ -1,0 +1,98 @@
+"""Quantized paged KV cache — int8 pools with per-block scale planes.
+
+The KV pool is the capacity ceiling for concurrent users (PR 8's HBM /
+fragmentation telemetry, PR 11's radix cache): at bf16 every cached
+token costs ``2 * kv_heads * head_dim`` bytes per layer per K/V side.
+This module halves that: K/V live in the pool as **int8** with an f32
+scale plane stored alongside, quantized on write and dequantized inside
+the attention kernel body (`ops/pallas/paged_attention.py`) — the bf16
+KV never round-trips HBM, so the same HBM budget holds ~2x the blocks
+and the pool admits ~2x the sequences (the Gemma-on-TPU quantized
+serving envelope, PAPERS.md arxiv 2605.25645).
+
+Scale granularity: one f32 per (block, kv_head, slot) — block-major
+per-head scale planes shaped like the cache minus its head_dim axis
+(``[num_blocks, kv_heads, block_size]`` against
+``[num_blocks, kv_heads, block_size, head_dim]``). Finer than one
+scalar per block in the token dimension on purpose: quantize-on-write
+is then EXACT and collision-free (each written token owns its scale
+slot; no read-modify-write of a shared block scalar, which a chunked
+prefill scattering many tokens into one block would race), and a COW
+block copy moves q + scale atomically because the scale plane is
+indexed by the same physical block id. Overhead is 4 bytes per
+``head_dim`` data bytes — reported honestly via `kv_bytes_per_block`
+so capacity claims audit from telemetry (`BlockCacheManager.
+fragmentation()`), not inference.
+
+Symmetric absmax quantization per (token, head): ``scale = amax|x| /
+127``; ``q = round(x / scale)``; dequant ``q * scale``. A zero vector
+stores q=0, scale=0 and decodes to exact zeros — guard slots stay
+inert.
+
+Host-side entry points here are trace-time helpers (pure jnp, called
+inside the engines' jitted ragged/verify programs); the byte accounting
+is plain python so `BlockCacheManager` telemetry stays jax-free.
+"""
+from __future__ import annotations
+
+__all__ = ["QMAX", "quantize_kv", "dequantize_kv", "scale_shape",
+           "kv_bytes_per_block", "kv_bytes_per_token"]
+
+QMAX = 127.0   # int8 symmetric range
+
+
+def quantize_kv(x):
+    """Quantize new K or V tokens ``[..., D] -> (q int8 [..., D],
+    scale f32 [...])`` with per-leading-index (token, head) absmax
+    scales. Traced inside the engines' ragged write — pure jnp."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / jnp.float32(QMAX)
+    safe = jnp.where(scale > 0, scale, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -QMAX, QMAX) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """``q int8 [..., D] * scale f32 [...] -> f32 [..., D]`` (the XLA
+    reference path; the Pallas kernel performs the same multiply in
+    VMEM)."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def scale_shape(cache_shape):
+    """The scale plane for a cache pool: the cache shape minus its
+    trailing head_dim axis (``[..., NB, KVH, BS, D] -> [..., NB, KVH,
+    BS]``)."""
+    return tuple(cache_shape[:-1])
+
+
+def kv_bytes_per_block(kv_heads: int, block_size: int, head_dim: int,
+                       kv_bits: int, dtype_bytes: int = 2,
+                       num_layers: int = 1) -> int:
+    """HBM bytes ONE pool block costs across K+V and all layers.
+
+    ``kv_bits == 8``: int8 data + one f32 scale per (head, slot);
+    otherwise the native-dtype cost (``dtype_bytes`` per element). The
+    number `BlockCacheManager.set_kv_geometry` publishes so capacity
+    claims (2x sequences per HBM byte) are auditable from
+    `fragmentation()` / OOM forensics dumps."""
+    per_side = kv_heads * block_size * head_dim
+    if kv_bits == 8:
+        side = per_side * 1 + kv_heads * block_size * 4   # q + f32 scale
+    else:
+        side = per_side * dtype_bytes
+    return 2 * side * num_layers                           # K and V
+
+
+def kv_bytes_per_token(kv_heads: int, block_size: int, head_dim: int,
+                       kv_bits: int, dtype_bytes: int = 2,
+                       num_layers: int = 1) -> float:
+    """HBM bytes one cached token costs (block bytes / block_size) —
+    the per-request `serving.kv_bytes_per_token` gauge."""
+    return kv_bytes_per_block(kv_heads, block_size, head_dim, kv_bits,
+                              dtype_bytes, num_layers) / block_size

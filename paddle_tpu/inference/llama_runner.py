@@ -80,33 +80,40 @@ _QUANT_KEYS = ("qkv_w", "o_w", "gate_up_w", "down_w")
 def _quantize_stacked(params, algo: str):
     """Weight-only-quantize the stacked [L, K, N] projection weights:
     -> {"q": int8/fp8 [L, N, K], "s": f32 [L, N]} per key (per-layer,
-    per-out-channel scales), via the shared `nn.quant.per_channel_quantize`
-    formulas."""
+    per-out-channel scales; int4 packs two nibbles per byte into
+    {"q4": [L, N, K//2], "s": [L, N]}), via the shared
+    `nn.quant.per_channel_quantize` / `pack_int4` formulas."""
     import jax.numpy as jnp
 
-    from ..nn.quant import per_channel_quantize
+    from ..nn.quant import pack_int4, per_channel_quantize
 
-    if algo not in ("int8", "fp8"):
-        raise ValueError(f"weight_only must be 'int8' or 'fp8', got {algo}")
+    if algo not in ("int8", "int4", "fp8"):
+        raise ValueError(
+            f"weight_only must be 'int8', 'int4' or 'fp8', got {algo}")
+    wq_algo = {"int8": "weight_only_int8", "int4": "weight_only_int4",
+               "fp8": "fp8"}[algo]
     out = dict(params)
     for key in _QUANT_KEYS:
         w = jnp.swapaxes(params[key].astype(jnp.float32), 1, 2)  # [L, N, K]
-        q, scale = per_channel_quantize(
-            w, "weight_only_int8" if algo == "int8" else "fp8")
-        out[key] = {"q": q, "s": scale}
+        q, scale = per_channel_quantize(w, wq_algo)
+        out[key] = {"q4": pack_int4(q), "s": scale} if algo == "int4" \
+            else {"q": q, "s": scale}
     return out
 
 
 def _mm(x, w):
     """x [..., K] @ layer weight: dense [K, N] array (einsum) or
-    weight-only-quantized {"q": [N, K], "s": [N]} via the shared
-    `nn.quant.dequant_matmul` (Pallas kernel on aligned TPU shapes)."""
+    weight-only-quantized {"q": [N, K], "s": [N]} / int4-packed
+    {"q4": [N, K//2], "s": [N]} via the shared `nn.quant.dequant_matmul`
+    (Pallas dequant-in-kernel gemm on aligned TPU shapes)."""
     import jax.numpy as jnp
 
     if not isinstance(w, dict):
         return jnp.einsum("...k,kn->...n", x, w.astype(x.dtype))
     from ..nn.quant import dequant_matmul
 
+    if "q4" in w:
+        return dequant_matmul(x, w["q4"], w["s"], "int4")
     return dequant_matmul(x, w["q"], w["s"])
 
 
@@ -139,11 +146,20 @@ class LlamaInferenceEngine:
     def __init__(self, model: LlamaForCausalLM, max_batch_size: int = 8,
                  num_blocks: int = 256, block_size: int = 16,
                  max_blocks_per_seq: int = 16, dtype=None,
-                 weight_only: str | None = None):
-        """`weight_only='int8'|'fp8'` stores the projection weights
-        quantized per-channel and dequantizes inside the gemm — the
-        decode-bandwidth path of the reference's cutlass int8/fp8 kernels
-        (`phi/kernels/fusion/cutlass/gemm_epilogue/`)."""
+                 weight_only: str | None = None, kv_bits: int = 16):
+        """`weight_only='int8'|'int4'|'fp8'` stores the projection
+        weights quantized per-channel and dequantizes inside the gemm —
+        the decode-bandwidth path of the reference's cutlass int8/fp8
+        kernels (`phi/kernels/fusion/cutlass/gemm_epilogue/`); int4
+        packs two nibbles per byte (`nn.quant.pack_int4`).
+
+        `kv_bits=8` stores the paged KV pool as int8 with per-slot f32
+        scale planes (`inference/kv_quant.py`): quantize-on-write in the
+        ragged scatter, dequantize inside the attention kernel — bf16 KV
+        never round-trips HBM, so the same HBM budget holds ~2x the
+        blocks. Quantized-KV engines serve through the ragged path
+        (`ragged_step`/`verify_step`, the scheduler's only dispatches);
+        the legacy `prefill`/`decode_step`/`generate` entry points raise."""
         import jax
         import jax.numpy as jnp
 
@@ -164,17 +180,59 @@ class LlamaInferenceEngine:
         cdtype = self.params["embed"].dtype
         L = cfg.num_hidden_layers
         kvh, d = cfg.num_key_value_heads, cfg.head_dim
-        self.k_cache = jnp.zeros((L, num_blocks, kvh, block_size, d), cdtype)
-        self.v_cache = jnp.zeros((L, num_blocks, kvh, block_size, d), cdtype)
+        self.kv_bits = int(kv_bits)
+        if self.kv_bits not in (8, 16):
+            raise ValueError(f"kv_bits must be 8 or 16, got {kv_bits}")
+        if self.kv_bits == 8:
+            self.k_cache = jnp.zeros((L, num_blocks, kvh, block_size, d),
+                                     jnp.int8)
+            self.v_cache = jnp.zeros((L, num_blocks, kvh, block_size, d),
+                                     jnp.int8)
+            self.k_scale = jnp.zeros((L, num_blocks, kvh, block_size),
+                                     jnp.float32)
+            self.v_scale = jnp.zeros((L, num_blocks, kvh, block_size),
+                                     jnp.float32)
+        else:
+            self.k_cache = jnp.zeros((L, num_blocks, kvh, block_size, d),
+                                     cdtype)
+            self.v_cache = jnp.zeros((L, num_blocks, kvh, block_size, d),
+                                     cdtype)
+            self.k_scale = self.v_scale = None
+        # KV byte geometry: published on the manager so fragmentation()
+        # and OOM forensics report bytes_per_block/kv_bits — capacity
+        # claims audit from telemetry, not inference
+        from . import kv_quant
+
+        self._kv_geom = dict(kv_heads=kvh, block_size=block_size,
+                             head_dim=d, kv_bits=self.kv_bits,
+                             dtype_bytes=jnp.dtype(cdtype).itemsize,
+                             num_layers=L)
+        self.manager.set_kv_geometry(
+            kv_quant.kv_bytes_per_block(**self._kv_geom), self.kv_bits)
 
         self._prefill = jax.jit(functools.partial(
             _prefill_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
         self._decode = jax.jit(functools.partial(
             _decode_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
-        self._verify = jax.jit(functools.partial(
-            _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
-        self._ragged = jax.jit(functools.partial(
-            _ragged_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
+        if self.kv_bits == 8:
+            self._verify = jax.jit(functools.partial(
+                _verify_q_fn, cfg=_StaticCfg(cfg)),
+                donate_argnums=(1, 2, 3, 4))
+            self._ragged = jax.jit(functools.partial(
+                _ragged_q_fn, cfg=_StaticCfg(cfg)),
+                donate_argnums=(1, 2, 3, 4))
+            # COW copy moves the int8 block AND its scale rows in ONE
+            # donated executable — q + scale can never tear apart
+            self._copy_block_q = jax.jit(
+                lambda k, v, ks, vs, s, d: (
+                    k.at[:, d].set(k[:, s]), v.at[:, d].set(v[:, s]),
+                    ks.at[:, d].set(ks[:, s]), vs.at[:, d].set(vs[:, s])),
+                donate_argnums=(0, 1, 2, 3))
+        else:
+            self._verify = jax.jit(functools.partial(
+                _verify_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
+            self._ragged = jax.jit(functools.partial(
+                _ragged_fn, cfg=_StaticCfg(cfg)), donate_argnums=(1, 2))
         # COW device copy (prefix caching, `BlockCacheManager` hook):
         # copies one physical block's K and V across every layer in one
         # donated executable; src/dst trace as int32 scalars, so COWs
@@ -195,7 +253,40 @@ class LlamaInferenceEngine:
         fn = {"prefill": self._prefill, "decode": self._ragged,
               "ragged": self._ragged, "decode_legacy": self._decode,
               "verify": self._verify}[phase]
+        if self.kv_bits == 8:
+            if phase not in ("decode", "ragged", "verify"):
+                # the legacy executables pair f32/bf16 writes with the
+                # int8 pool — a program this engine can never legally
+                # run must not get a cost card (the caller tombstones)
+                raise KeyError(
+                    f"{phase!r} has no executable on a kv_bits=8 engine")
+            return fn, (self.params, self.k_cache, self.v_cache,
+                        self.k_scale, self.v_scale)
         return fn, (self.params, self.k_cache, self.v_cache)
+
+    def kv_bytes_per_token(self) -> float:
+        """HBM bytes one cached token costs across K+V and all layers
+        (int8 pools include their scale-plane overhead) — the
+        `serving.kv_bytes_per_token` gauge and the capacity-math input
+        (docs/SERVING.md "Quantized serving")."""
+        from . import kv_quant
+
+        return kv_quant.kv_bytes_per_token(**self._kv_geom)
+
+    def quant_info(self) -> dict:
+        """Quantization mode surface the serving metrics publish
+        (`serving.quant.{wbits,kv_bits}`): weight bits (16 = native
+        dtype), KV bits, and the per-token KV byte cost."""
+        wb = {"int8": 8, "int4": 4, "fp8": 8}.get(self.weight_only, 16)
+        return {"wbits": wb, "kv_bits": self.kv_bits,
+                "kv_bytes_per_token": self.kv_bytes_per_token()}
+
+    def _require_full_kv(self, entry: str):
+        if self.kv_bits != 16:
+            raise RuntimeError(
+                f"{entry} is a legacy full-precision entry point; a "
+                f"kv_bits={self.kv_bits} engine serves through "
+                "ragged_step/verify_step (the scheduler's only dispatches)")
 
     # ---- public API (the serving EngineCore surface) ----
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
@@ -210,6 +301,7 @@ class LlamaInferenceEngine:
         block allocation — callers trim via `BlockCacheManager.trim`, and
         decode overwrites position `lens` onward, so the garbage is never
         attended to."""
+        self._require_full_kv("prefill")
         b, s = np.asarray(input_ids).shape
         if lens is None:
             lens = np.full((b,), s, np.int32)
@@ -227,6 +319,7 @@ class LlamaInferenceEngine:
                     block_tables: np.ndarray):
         """tokens [B] int32 (newest token per seq, already counted in
         context_lens); returns logits [B, V]."""
+        self._require_full_kv("decode_step")
         logits, self.k_cache, self.v_cache = self._decode(
             self.params, self.k_cache, self.v_cache,
             np.asarray(tokens, np.int32),
@@ -250,6 +343,15 @@ class LlamaInferenceEngine:
         Shape-stable in everything but T, which the scheduler fixes at
         `max_batch_size + prefill_chunk_tokens` — one compiled
         executable regardless of batch composition or prompt length."""
+        if self.kv_bits == 8:
+            (logits, self.k_cache, self.v_cache, self.k_scale,
+             self.v_scale) = self._ragged(
+                self.params, self.k_cache, self.v_cache, self.k_scale,
+                self.v_scale, np.asarray(tokens, np.int32),
+                np.asarray(q_lens, np.int32),
+                np.asarray(kv_lens, np.int32),
+                np.asarray(block_tables, np.int32))
+            return logits
         logits, self.k_cache, self.v_cache = self._ragged(
             self.params, self.k_cache, self.v_cache,
             np.asarray(tokens, np.int32),
@@ -270,6 +372,14 @@ class LlamaInferenceEngine:
         logits [B, S, V]: row i is the distribution for the token AFTER
         tokens[:, i] — rows 0..S-2 verify the drafts, row S-1 samples the
         bonus token when every draft is accepted."""
+        if self.kv_bits == 8:
+            (logits, self.k_cache, self.v_cache, self.k_scale,
+             self.v_scale) = self._verify(
+                self.params, self.k_cache, self.v_cache, self.k_scale,
+                self.v_scale, np.asarray(tokens, np.int32),
+                np.asarray(context_lens, np.int32),
+                np.asarray(block_tables, np.int32))
+            return logits
         logits, self.k_cache, self.v_cache = self._verify(
             self.params, self.k_cache, self.v_cache,
             np.asarray(tokens, np.int32),
@@ -279,7 +389,15 @@ class LlamaInferenceEngine:
 
     def copy_kv_block(self, src: int, dst: int) -> None:
         """Copy one physical KV block, all layers (`BlockCacheManager`
-        COW hook — the scheduler wires it when prefix caching is on)."""
+        COW hook — the scheduler wires it when prefix caching is on).
+        Int8 pools move the block's scale rows in the same donated
+        executable — q and scale stay atomic under COW."""
+        if self.kv_bits == 8:
+            (self.k_cache, self.v_cache, self.k_scale,
+             self.v_scale) = self._copy_block_q(
+                self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                np.int32(src), np.int32(dst))
+            return
         self.k_cache, self.v_cache = self._copy_block(
             self.k_cache, self.v_cache, np.int32(src), np.int32(dst))
 
@@ -288,6 +406,9 @@ class LlamaInferenceEngine:
         """Greedy/sampling generation. input_ids: [B, S] (equal-length
         prompts; ragged batches go through per-sequence prefill calls).
         Returns [B, S + max_new_tokens]."""
+        # guard BEFORE any allocation: raising from prefill() below
+        # would leave the just-leased blocks permanently held
+        self._require_full_kv("generate")
         gc = generation_config or GenerationConfig(**kw)
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
@@ -365,7 +486,7 @@ class _StaticCfg:
 
 
 def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
-                ragged_meta=None):
+                ragged_meta=None, kv_scales=None):
     """One decoder layer on [B, S, H]; returns (x, (new_k_blocks, new_v_blocks)).
 
     `mode`: "prefill" (dense causal SDPA over the in-flight tokens),
@@ -374,7 +495,12 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
     "ragged" (packed mixed prefill-chunk/decode/verify tokens: x is
     [1, T, H], `ragged_meta` = (tok_lane, tok_pos) maps every packed
     token to its lane and absolute position, ctx_lens is per-lane
-    kv_lens — ONE fixed-shape program for every batch composition)."""
+    kv_lens — ONE fixed-shape program for every batch composition).
+
+    `kv_scales` = (k_scale, v_scale) per-slot planes marks an int8
+    quantized KV pool (`inference/kv_quant.py`, ragged mode only):
+    writes quantize, attention dequantizes in-kernel, and the layer
+    returns (x, (kc, vc, ks, vs))."""
     import jax
     import jax.numpy as jnp
 
@@ -397,22 +523,33 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
 
     if mode == "ragged":
         tok_lane, tok_pos = ragged_meta
-        kc, vc = pk.write_kv_to_cache_ragged(
-            k[0], v[0], kc, vc, tables, tok_lane, tok_pos)
+        ks = vs = None
+        if kv_scales is not None:
+            ks, vs = kv_scales
+            kc, vc, ks, vs = pk.write_kv_to_cache_ragged(
+                k[0], v[0], kc, vc, tables, tok_lane, tok_pos,
+                k_scale=ks, v_scale=vs)
+        else:
+            kc, vc = pk.write_kv_to_cache_ragged(
+                k[0], v[0], kc, vc, tables, tok_lane, tok_pos)
         qr = q[0]                                     # [T, NH, D]
         if pk.ragged_supported((s, nh, d), qr.dtype):
             attn = pk.paged_attention_ragged(
-                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos)
+                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos,
+                k_scale=ks, v_scale=vs)
         else:
             attn = pk.paged_attention_ragged_ref(
-                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos)
-        attn = attn.reshape(1, s, nh * d)
+                qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos,
+                k_scale=ks, v_scale=vs)
+        attn = attn.reshape(1, s, nh * d).astype(x.dtype)
         x = x + _mm(attn, o_w)
         h2 = _rms(x, ln2, cfg.eps)
         gu = _mm(h2, gu_w)
         g, u = jnp.split(gu, 2, axis=-1)
         act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
         x = x + _mm(act, down_w)
+        if kv_scales is not None:
+            return x, (kc, vc, ks, vs)
         return x, (kc, vc)
 
     start = positions[:, 0].astype(jnp.int32)
@@ -451,13 +588,22 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
 
 
 def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
-               cfg, mode, ragged_meta=None):
+               cfg, mode, ragged_meta=None, k_scale=None, v_scale=None):
     import jax
     import jax.numpy as jnp
 
     cos, sin = params["rope_cos"], params["rope_sin"]
+    quant_kv = k_scale is not None
 
     def body(x, layer_xs):
+        if quant_kv:
+            ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc, ks, vs = layer_xs
+            x, carry = _layer_body(
+                x, (ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc, cos, sin),
+                cfg=cfg, positions=positions, tables=tables,
+                ctx_lens=ctx_lens, mode=mode, ragged_meta=ragged_meta,
+                kv_scales=(ks, vs))
+            return x, carry
         ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc = layer_xs
         x, (kc, vc) = _layer_body(
             x, (ln1, qkv_w, o_w, ln2, gu_w, down_w, kc, vc, cos, sin),
@@ -467,14 +613,25 @@ def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
 
     xs = (params["ln1"], params["qkv_w"], params["o_w"], params["ln2"],
           params["gate_up_w"], params["down_w"], k_cache, v_cache)
-    x, (new_k, new_v) = jax.lax.scan(body, x, xs)
+    if quant_kv:
+        xs = xs + (k_scale, v_scale)
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(body, x, xs)
+    else:
+        x, (new_k, new_v) = jax.lax.scan(body, x, xs)
+        new_ks = new_vs = None
     x = _rms(x, params["final_norm"], cfg.eps)
     head = params.get("lm_head")
     if head is None:
         logits = jnp.einsum("bsh,vh->bsv", x,
                             params["embed"].astype(x.dtype))
+    elif isinstance(head, dict):
+        # weight-only-quantized head (serving/quant.py): the vocab gemm
+        # is the largest single matmul of a decode step
+        logits = _mm(x, head)
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, head.astype(x.dtype))
+    if quant_kv:
+        return logits, new_k, new_v, new_ks, new_vs
     return logits, new_k, new_v
 
 
@@ -514,10 +671,11 @@ def _decode_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
 
 
 def _ragged_stack(params, k_cache, v_cache, tokens, q_lens, kv_lens,
-                  tables, cfg):
+                  tables, cfg, k_scale=None, v_scale=None):
     """Shared body of the ragged and verify entry points: packed tokens
     [T] + per-lane (q_len, kv_len) metadata through the decoder stack in
-    ragged mode. Returns (logits [T, V], new_k, new_v)."""
+    ragged mode. Returns (logits [T, V], new_k, new_v[, new_ks, new_vs
+    when the KV pool is int8-quantized])."""
     import jax.numpy as jnp
 
     from ..ops.pallas import paged_attention as pk
@@ -526,11 +684,12 @@ def _ragged_stack(params, k_cache, v_cache, tokens, q_lens, kv_lens,
     tok_lane, tok_pos = pk.ragged_metadata(q_lens, kv_lens, t)
     x = jnp.take(params["embed"], tokens[None, :], axis=0)   # [1, T, H]
     positions = jnp.maximum(tok_pos, 0)[None, :]             # [1, T]
-    logits, nk, nv = _run_stack(
+    out = _run_stack(
         params, k_cache, v_cache, x, positions, tables,
         kv_lens.astype(jnp.int32), cfg, mode="ragged",
-        ragged_meta=(tok_lane, tok_pos))
-    return logits[0].astype(jnp.float32), nk, nv             # [T, V]
+        ragged_meta=(tok_lane, tok_pos), k_scale=k_scale, v_scale=v_scale)
+    logits, rest = out[0], out[1:]
+    return (logits[0].astype(jnp.float32),) + rest           # [T, V]
 
 
 def _ragged_fn(params, k_cache, v_cache, tokens, q_lens, kv_lens, tables,
@@ -545,6 +704,20 @@ def _ragged_fn(params, k_cache, v_cache, tokens, q_lens, kv_lens, tables,
     monitor.inc("serving.ragged_retraces")
     return _ragged_stack(params, k_cache, v_cache, tokens, q_lens,
                          kv_lens, tables, cfg)
+
+
+def _ragged_q_fn(params, k_cache, v_cache, k_scale, v_scale, tokens,
+                 q_lens, kv_lens, tables, *, cfg):
+    """The int8-KV serving decode program (`kv_bits=8`): same packed
+    ragged step, with the pool's scale planes donated alongside the
+    caches — quantize-on-write and in-kernel dequant, one executable."""
+    from ..framework import monitor
+
+    monitor.inc("serving.decode_retraces")  # trace-time (see _ragged_fn)
+    monitor.inc("serving.ragged_retraces")
+    return _ragged_stack(params, k_cache, v_cache, tokens, q_lens,
+                         kv_lens, tables, cfg, k_scale=k_scale,
+                         v_scale=v_scale)
 
 
 def _verify_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
@@ -563,3 +736,21 @@ def _verify_fn(params, k_cache, v_cache, tokens, ctx_lens, tables, *, cfg):
                                    q_lens, ctx_lens.astype(jnp.int32),
                                    tables, cfg)
     return logits.reshape(b, s, -1), nk, nv                  # [B, S, V]
+
+
+def _verify_q_fn(params, k_cache, v_cache, k_scale, v_scale, tokens,
+                 ctx_lens, tables, *, cfg):
+    """Verify over an int8-quantized KV pool (rides the quantized
+    ragged stack exactly as `_verify_fn` rides the plain one)."""
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.verify_retraces")  # trace-time only
+    b, s = tokens.shape
+    q_lens = jnp.full((b,), s, jnp.int32)
+    logits, nk, nv, nks, nvs = _ragged_stack(
+        params, k_cache, v_cache, tokens.reshape(b * s), q_lens,
+        ctx_lens.astype(jnp.int32), tables, cfg, k_scale=k_scale,
+        v_scale=v_scale)
+    return logits.reshape(b, s, -1), nk, nv, nks, nvs        # [B, S, V]

@@ -18,13 +18,29 @@ from ..layer.layers import Layer
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "llm_int8_linear", "apply_per_channel_scale", "WeightOnlyLinear",
-           "per_channel_quantize", "dequant_matmul"]
+           "per_channel_quantize", "dequant_matmul", "pack_int4",
+           "unpack_int4", "quantize_with_scales"]
 
 _ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8", "fp8")
 
 
 def _arr(x):
     return x._data if isinstance(x, Tensor) else x
+
+
+def quantize_with_scales(w, scales, bits: int):
+    """Round/clip `w [..., N, K]` to int8 storage at the GIVEN
+    per-channel scales (`[..., N]`, the `absmax / qmax` convention).
+    The single int-quantization step — `per_channel_quantize` routes its
+    own absmax scales here, `serving.quant.quantize_engine` its
+    observer-calibrated ones, so the round/clip/zero-scale formula
+    cannot drift between the constructor and offline passes."""
+    import jax.numpy as jnp
+
+    qmax = (1 << (bits - 1)) - 1                      # 7 or 127
+    safe = jnp.where(scales > 0, scales, 1.0)
+    return jnp.clip(jnp.round(w / safe[..., None]), -qmax, qmax) \
+        .astype(jnp.int8)
 
 
 def per_channel_quantize(w, algo: str):
@@ -42,9 +58,7 @@ def per_channel_quantize(w, algo: str):
         bits = 4 if algo == "weight_only_int4" else 8
         qmax = (1 << (bits - 1)) - 1                  # 7 or 127
         scale = jnp.max(jnp.abs(w), axis=-1) / qmax
-        safe = jnp.where(scale > 0, scale, 1.0)
-        q = jnp.clip(jnp.round(w / safe[..., None]), -qmax, qmax) \
-            .astype(jnp.int8)
+        q = quantize_with_scales(w, scale, bits)
     return q, scale.astype(jnp.float32)
 
 
@@ -64,22 +78,54 @@ def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
             f"in_features, got {w.shape[1]}")
     q, scale = per_channel_quantize(w, algo)
     if algo == "weight_only_int4":
-        lo = q[:, 0::2] & 0x0F                       # pack two nibbles
-        hi = (q[:, 1::2] & 0x0F) << 4
-        q = (lo | hi).astype(jnp.int8)
+        q = pack_int4(q)
     return (Tensor(q, stop_gradient=True),
             Tensor(scale, stop_gradient=True))
 
 
-def _unpack_int4(q):
-    """[N, K//2] packed -> [N, K] int8 with sign extension."""
+def pack_int4(q):
+    """Pack int4 values (int8 storage, range [-8, 7]) two-per-byte along
+    the LAST axis: ``[..., K] -> [..., K//2]``.
+
+    SPLIT-HALF layout (not interleaved): byte j holds ``q[..., j]`` in
+    the low nibble and ``q[..., K//2 + j]`` in the high nibble. The
+    layout exists for the Pallas int4 gemm (`ops/pallas/quant_matmul`):
+    unpacking a K-block is then two nibble extractions feeding two MXU
+    contractions against the matching halves of the activation block —
+    no in-kernel lane interleave/relayout. `unpack_int4` inverts it
+    exactly for every representable value (round-trip property test in
+    tests/test_quant_serving.py).
+
+    FORMAT BREAK (PR 14): this replaced the earlier interleaved packing
+    (byte j = q[2j], q[2j+1]). An int4 `WeightOnlyLinear` checkpoint
+    written BEFORE the change loads shape/dtype-clean but decodes
+    column-permuted — re-quantize from the float checkpoint instead of
+    loading stale int4 buffers. (int8/fp8 storage is unaffected; no
+    in-tree artifact carries the old layout.)"""
+    import jax.numpy as jnp
+
+    k = q.shape[-1]
+    if k % 2:
+        raise ValueError(f"pack_int4 needs an even last axis, got {k}")
+    lo = q[..., :k // 2] & 0x0F
+    hi = (q[..., k // 2:] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def unpack_int4(q):
+    """``[..., K//2]`` split-half packed -> ``[..., K]`` int8 with sign
+    extension (exact inverse of `pack_int4`)."""
     import jax.numpy as jnp
 
     lo = (q & 0x0F).astype(jnp.int8)
     lo = jnp.where(lo >= 8, lo - 16, lo)
     hi = ((q >> 4) & 0x0F).astype(jnp.int8)
     hi = jnp.where(hi >= 8, hi - 16, hi)
-    return jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+# back-compat alias (pre-split-half callers used the private name)
+_unpack_int4 = unpack_int4
 
 
 def weight_dequantize(x, scale, algo: str = "weight_only_int8",
@@ -112,16 +158,29 @@ def dequant_matmul(x, wq, scale, weight_dtype: str = "int8"):
     k = x.shape[-1]
     x2d = x.reshape(-1, k)
     n = wq.shape[0]
-    unpacked = _unpack_int4(wq) if weight_dtype == "int4" else wq
+    if weight_dtype == "int4":
+        # wq is split-half packed [N, K//2]; the Pallas path unpacks the
+        # nibbles in VMEM (two contractions against the activation
+        # halves), the XLA path unpacks ahead of the matmul (the convert
+        # fuses into the gemm there)
+        if (_support.kernels_enabled()
+                and qm.int4_supported(x2d.shape, wq.shape, wq.dtype)
+                and x2d.shape[0] % 8 == 0 and n % 128 == 0
+                and k % 256 == 0):
+            out = qm.quant_matmul_int4(x2d, wq, scale, out_dtype=x.dtype)
+        else:
+            wf = unpack_int4(wq).astype(x.dtype) \
+                * scale[:, None].astype(x.dtype)
+            out = x2d @ wf.T
+        return out.reshape(lead + (n,))
     use_pallas = (_support.kernels_enabled()
-                  and weight_dtype != "int4"
                   and qm.supported(x2d.shape, wq.shape, wq.dtype)
                   and x2d.shape[0] % 8 == 0 and n % 128 == 0
                   and k % 128 == 0)
     if use_pallas:
         out = qm.quant_matmul(x2d, wq, scale, out_dtype=x.dtype)
     else:
-        wf = unpacked.astype(x.dtype) * scale[:, None].astype(x.dtype)
+        wf = wq.astype(x.dtype) * scale[:, None].astype(x.dtype)
         out = x2d @ wf.T
     return out.reshape(lead + (n,))
 

@@ -51,6 +51,14 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
     # >1.5x tok/s) and carried as evidence
     "serving_shared_prefix": [("value", "higher"),
                               ("extras.ttft_shared_p99_ms", "lower")],
+    # quantized serving (ROADMAP item 4): tok/s of the int8(w)+int8(KV)
+    # stack at 2x admitted concurrency, the admitted-concurrency ratio
+    # vs the full-precision pool at EQUAL KV bytes (the capacity claim
+    # itself), and tail TTFT under the burst; greedy top-1 agreement
+    # >= 99% and spec==plain parity are asserted in-run
+    "serving_quant": [("value", "higher"),
+                     ("extras.concurrency_x", "higher"),
+                     ("extras.ttft_p99_ms", "lower")],
     # fleet-router scaling (ROADMAP item 5): aggregate throughput at the
     # top replica count, the 1->4 scaling ratio (the router-overhead
     # contract — near-linear or the control plane is serializing
@@ -89,6 +97,9 @@ SCENARIO_GATE_PCT: Dict[str, float] = {
     # cached-vs-cold ratio asserts are the hard contract, the gate
     # catches order-of-magnitude regressions
     "serving_shared_prefix": 25.0,
+    # closed-loop burst walls on the same contended box: the in-run
+    # concurrency/agreement/parity asserts are the hard contract
+    "serving_quant": 25.0,
 }
 
 

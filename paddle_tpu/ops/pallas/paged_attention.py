@@ -377,6 +377,109 @@ def _ragged_call(q, k_cache, v_cache, block_tables, kv_lens, tok_lane,
     )(kv_lens, block_tables, tok_lane, tok_pos, q, k_cache, v_cache)
 
 
+def _ragged_kernel_q(kv_lens_ref, tables_ref, lane_ref, pos_ref,
+                     q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                     acc_ref, m_ref, l_ref, *, sm_scale, block_size):
+    """Quantized-KV ragged kernel: identical online-softmax structure to
+    `_ragged_kernel`, but K/V arrive as int8 blocks with their per-slot
+    f32 scale rows (`inference/kv_quant.py` layout) and dequantize in
+    VMEM right before the MXU — the bf16/f32 KV never exists in HBM,
+    which is the whole point: a decode step is KV-bandwidth-bound, so
+    halving the bytes read halves the step's HBM traffic."""
+    t = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    lane = lane_ref[t]
+    ctx_len = kv_lens_ref[lane]
+    qpos = pos_ref[t]
+
+    @pl.when((j * block_size < ctx_len) & (qpos >= 0))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (Gp, D)
+        # dequant in VMEM: int8 block * per-slot scale column
+        k = k_ref[0, 0].astype(jnp.float32) \
+            * ks_ref[0, 0][:, None]                         # (BS, D)
+        v = v_ref[0, 0].astype(jnp.float32) \
+            * vs_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # typed scalar: see the NEG_INF note in _decode_kernel
+        s = jnp.where(pos <= qpos, s, jnp.float32(NEG_INF))
+        m_prev = m_ref[...][:, 0]
+        l_prev = l_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = m_new[:, None]
+        l_ref[...] = l_new[:, None]
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, jnp.float32(1.0), l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _ragged_call_q(q, k_cache, v_cache, k_scale, v_scale, block_tables,
+                   kv_lens, tok_lane, tok_pos, sm_scale):
+    """q: [T, KV_H, Gp, D]; caches int8 [KV_H, NB, BS, D]; scales f32
+    [KV_H, NB, BS] (head-major, matching the cache swap)."""
+    tokens, kv_h, g_pad, d = q.shape
+    block_size = k_cache.shape[2]
+    max_blocks = block_tables.shape[1]
+
+    kern = functools.partial(_ragged_kernel_q, sm_scale=sm_scale,
+                             block_size=block_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(tokens, kv_h, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g_pad, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (t, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, d),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda t, h, j, lens, tables, lane, pos:
+                         (h, tables[lane[t], j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g_pad, d),
+                               lambda t, h, j, lens, tables, lane, pos:
+                               (t, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, d), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+            pltpu.VMEM((g_pad, 1), jnp.float32),
+        ],
+    )
+    return _support.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, kv_h, g_pad, d), q.dtype),
+        interpret=_support.interpret_mode(),
+    )(kv_lens, block_tables, tok_lane, tok_pos, q, k_cache, v_cache,
+      k_scale, v_scale)
+
+
 def ragged_metadata(q_lens, kv_lens, num_tokens):
     """Per-token `(lane, position)` metadata for the packed query buffer.
 
@@ -399,7 +502,8 @@ def ragged_metadata(q_lens, kv_lens, num_tokens):
 
 
 def paged_attention_ragged(q, k_cache, v_cache, block_tables, kv_lens,
-                           tok_lane, tok_pos, sm_scale=None):
+                           tok_lane, tok_pos, sm_scale=None,
+                           k_scale=None, v_scale=None):
     """Ragged paged attention over a packed query token buffer.
 
     ONE kernel for every serving batch composition: decode lanes
@@ -417,6 +521,10 @@ def paged_attention_ragged(q, k_cache, v_cache, block_tables, kv_lens,
          dispatch's own tokens (0 for empty lanes).
       tok_lane/tok_pos: [T] int32 per-token owner lane / absolute
          position (-1 = guard slot, output forced to 0).
+      k_scale/v_scale: optional f32 [num_blocks, kv_heads, block_size]
+         per-slot scale planes for int8 quantized caches
+         (`inference/kv_quant.py`): dequantization then happens inside
+         the kernel body, right before the MXU.
     Returns [T, H, D]; guard rows are exact zeros.
     """
     tokens, h, d = q.shape
@@ -430,10 +538,19 @@ def paged_attention_ragged(q, k_cache, v_cache, block_tables, kv_lens,
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
     kc = jnp.swapaxes(k_cache, 0, 1)  # [KV_H, NB, BS, D]
     vc = jnp.swapaxes(v_cache, 0, 1)
-    out = _ragged_call(qg, kc, vc, block_tables.astype(jnp.int32),
-                       kv_lens.astype(jnp.int32),
-                       tok_lane.astype(jnp.int32),
-                       tok_pos.astype(jnp.int32), float(sm_scale))
+    if k_scale is not None:
+        out = _ragged_call_q(
+            qg, kc, vc,
+            jnp.swapaxes(k_scale, 0, 1),   # [KV_H, NB, BS]
+            jnp.swapaxes(v_scale, 0, 1),
+            block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+            tok_lane.astype(jnp.int32), tok_pos.astype(jnp.int32),
+            float(sm_scale))
+    else:
+        out = _ragged_call(qg, kc, vc, block_tables.astype(jnp.int32),
+                           kv_lens.astype(jnp.int32),
+                           tok_lane.astype(jnp.int32),
+                           tok_pos.astype(jnp.int32), float(sm_scale))
     return out[:, :, :g, :].reshape(tokens, h, d)
 
 
@@ -444,14 +561,20 @@ _REF_TOKEN_TILE = 128
 
 
 def paged_attention_ragged_ref(q, k_cache, v_cache, block_tables, kv_lens,
-                               tok_lane, tok_pos, sm_scale=None):
+                               tok_lane, tok_pos, sm_scale=None,
+                               k_scale=None, v_scale=None):
     """XLA reference for the ragged kernel (also the CPU fallback).
 
     Same gather + masked-softmax structure as `paged_attention_ref`, per
     packed token; guard rows (tok_pos < 0) come back exactly zero. Large
     packed buffers (T > _REF_TOKEN_TILE) stream through `lax.map` token
     tiles so the gathered windows stay bounded — each row's reduction is
-    unchanged, only how many rows are materialized at once."""
+    unchanged, only how many rows are materialized at once.
+
+    `k_scale`/`v_scale` (f32 [NB, KVH, BS]) mark int8 quantized caches:
+    the gathered per-lane windows dequantize right after the gather —
+    only the gathered window is ever materialized in float, never the
+    pool."""
     tokens, h, d = q.shape
     nb, kv_h, bs, _ = k_cache.shape
     g = h // kv_h
@@ -459,6 +582,11 @@ def paged_attention_ragged_ref(q, k_cache, v_cache, block_tables, kv_lens,
         sm_scale = 1.0 / float(np.sqrt(d))
     k = jnp.take(k_cache, block_tables, axis=0)   # [B, W, KV_H, BS, D]
     v = jnp.take(v_cache, block_tables, axis=0)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, block_tables, axis=0)   # [B, W, KV_H, BS]
+        vs = jnp.take(v_scale, block_tables, axis=0)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     max_s = block_tables.shape[1] * bs
     k = jnp.swapaxes(k, 2, 3).reshape(block_tables.shape[0], max_s, kv_h, d)
     v = jnp.swapaxes(v, 2, 3).reshape(block_tables.shape[0], max_s, kv_h, d)
@@ -570,14 +698,25 @@ def write_kv_to_cache(k, v, k_cache, v_cache, block_tables, start_pos):
 
 
 def write_kv_to_cache_ragged(k, v, k_cache, v_cache, block_tables,
-                             tok_lane, tok_pos):
+                             tok_lane, tok_pos, k_scale=None,
+                             v_scale=None):
     """Scatter packed ragged K/V tokens into the block pool.
 
     k/v: [T, KV_H, D] — one new token per packed slot, landing at
     absolute position `tok_pos[t]` of lane `tok_lane[t]`'s block table.
     Guard slots (tok_pos < 0) are routed to an out-of-bounds flat index,
     which jnp scatter DROPS under jit — no guard-block lease needed for
-    the ragged write path. Returns updated (k_cache, v_cache)."""
+    the ragged write path. Returns updated (k_cache, v_cache).
+
+    Quantize-on-write (`inference/kv_quant.py`): when `k_scale`/
+    `v_scale` planes (f32 [NB, KVH, BS]) ride along, each token's K/V
+    quantizes to int8 with its own per-head absmax scale and BOTH the
+    int8 values and the scale scatter at the same flat index — exact,
+    collision-free (no shared block scalar to read-modify-write), and
+    atomic with respect to the guard-slot drop. Returns (k_cache,
+    v_cache, k_scale, v_scale) in that case."""
+    from ...inference import kv_quant
+
     tokens, kv_h, d = k.shape
     nb, _, bs, _ = k_cache.shape
     pos = jnp.maximum(tok_pos, 0)
@@ -586,6 +725,20 @@ def write_kv_to_cache_ragged(k, v, k_cache, v_cache, block_tables,
                      jnp.int32(nb * bs))                      # OOB -> drop
     kc = k_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
     vc = v_cache.swapaxes(1, 2).reshape(nb * bs, kv_h, d)
+    if k_scale is not None:
+        kq, ks_tok = kv_quant.quantize_kv(k)                  # [T,KVH,(D)]
+        vq, vs_tok = kv_quant.quantize_kv(v)
+        ks = k_scale.swapaxes(1, 2).reshape(nb * bs, kv_h)
+        vs = v_scale.swapaxes(1, 2).reshape(nb * bs, kv_h)
+        kc = kc.at[flat].set(kq)
+        vc = vc.at[flat].set(vq)
+        ks = ks.at[flat].set(ks_tok)
+        vs = vs.at[flat].set(vs_tok)
+        kc = kc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+        vc = vc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)
+        ks = ks.reshape(nb, bs, kv_h).swapaxes(1, 2)
+        vs = vs.reshape(nb, bs, kv_h).swapaxes(1, 2)
+        return kc, vc, ks, vs
     kc = kc.at[flat].set(k)
     vc = vc.at[flat].set(v)
     kc = kc.reshape(nb, bs, kv_h, d).swapaxes(1, 2)

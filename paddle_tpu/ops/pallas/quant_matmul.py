@@ -112,6 +112,83 @@ def _quant_matmul_fwd_only(x2d, wq, scale, out_dtype=None):
     return _build_qmm(m, n, k, out_dtype, cfg)(x2d, wq, scale)
 
 
+def _qmm4_kernel(xlo_ref, xhi_ref, wp_ref, s_ref, o_ref, acc_ref, *, n_k):
+    """One (i, j, k) grid step of the packed-int4 gemm.
+
+    `wp` is the SPLIT-HALF packed weight block [bn, bkp] (bkp = bk/2
+    bytes, see `nn.quant.pack_int4`): the low nibble of byte c is weight
+    column c of the K first-half, the high nibble column c of the
+    second-half. Unpacking is therefore two nibble extractions feeding
+    two MXU contractions against the matching activation halves — no
+    in-kernel lane interleave, which an interleaved packing would need.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xlo = xlo_ref[...]                           # [bm, bkp] bf16/f32
+    xhi = xhi_ref[...]
+    wp = wp_ref[...]                             # [bn, bkp] int8 packed
+    lo = wp & 0x0F                               # int32 ops: nibble +
+    lo = jnp.where(lo >= 8, lo - 16, lo)         # sign extension
+    hi = (wp >> 4) & 0x0F
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    acc_ref[...] += jax.lax.dot_general(
+        xlo, lo.astype(xlo.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] += jax.lax.dot_general(
+        xhi, hi.astype(xhi.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _done():
+        scale = s_ref[...].astype(jnp.float32)   # [bn]
+        o_ref[...] = (acc_ref[...] * scale[None, :]).astype(o_ref.dtype)
+
+
+def _build_qmm4(m, n, kp, out_dtype, cfg):
+    """kp = K // 2: the packed-byte axis the K grid iterates over. The
+    activation is read as TWO blocks per step — block column kk of the
+    first K-half and kk + n_k of the second — so its BlockSpec stays in
+    bkp units with no relayout."""
+    bm, bn, bkp = cfg
+    n_k = pl.cdiv(kp, bkp)
+    return _support.pallas_call(
+        functools.partial(_qmm4_kernel, n_k=n_k),
+        grid=(pl.cdiv(m, bm), pl.cdiv(n, bn), n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bkp), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bm, bkp),
+                         lambda i, j, kk, _n=n_k: (i, kk + _n)),
+            pl.BlockSpec((bn, bkp), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bn,), lambda i, j, kk: (j,)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=_jax_compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_support.interpret_mode(),
+    )
+
+
+def quant_matmul_int4(x2d, wq_packed, scale, out_dtype=None):
+    """x2d [M, K] @ dequant(split-half packed wq [N, K//2], scale [N])
+    -> [M, N]. Forward-only (int4 is a deploy format; training never
+    sees it) — the serving weight-only decode path for wbits=4."""
+    m, k = x2d.shape
+    n, kp = wq_packed.shape
+    assert k == 2 * kp, (x2d.shape, wq_packed.shape)
+    out_dtype = out_dtype or x2d.dtype
+    cfg = (_support.pick_block(m, 256) or m,
+           _support.pick_block(n, 512) or n,
+           _support.pick_block(kp, 256) or kp)
+    return _build_qmm4(m, n, kp, out_dtype, cfg)(x2d, x2d, wq_packed,
+                                                 scale)
+
+
 def supported(x_shape, w_shape, w_dtype) -> bool:
     """Pallas path: int8/fp8 2-D weights, dims divisible into legal tiles."""
     import numpy as np
@@ -120,3 +197,17 @@ def supported(x_shape, w_shape, w_dtype) -> bool:
         return False
     name = np.dtype(w_dtype).name if not isinstance(w_dtype, str) else w_dtype
     return name in ("int8", "float8_e4m3fn", "float8_e5m2")
+
+
+def int4_supported(x_shape, wp_shape, wp_dtype) -> bool:
+    """Gate for `quant_matmul_int4`: split-half packed int8 storage,
+    2-D, K = 2 * packed width."""
+    import numpy as np
+
+    if len(x_shape) != 2 or len(wp_shape) != 2:
+        return False
+    if x_shape[1] != 2 * wp_shape[1]:
+        return False
+    name = np.dtype(wp_dtype).name if not isinstance(wp_dtype, str) \
+        else wp_dtype
+    return name == "int8"

@@ -503,6 +503,15 @@ class Profiler:
                 f"  TTFT p50 {g('serving.ttft_p50_ms')} ms / "
                 f"p99 {g('serving.ttft_p99_ms')} ms, "
                 f"TPOT mean {g('serving.tpot_mean_ms')} ms")
+        # Quantized serving block: rendered once an engine published a
+        # non-default mode (serving/quant.py; docs/SERVING.md
+        # "Quantized serving")
+        wb, kb = g("serving.quant.wbits"), g("serving.quant.kv_bits")
+        if (wb and wb != 16) or (kb and kb != 16):
+            fmt = lambda b: "native" if b == 16 else f"int{b}"  # noqa: E731
+            lines.append(
+                f"  quant: weights {fmt(wb)}, KV {fmt(kb)}, "
+                f"{g('serving.kv_bytes_per_token')} KV bytes/token")
         if g("serving.spec_steps"):
             lines.append(
                 f"  speculative: {g('serving.spec_accepted_tokens')}/"

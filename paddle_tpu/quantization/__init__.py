@@ -18,8 +18,9 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
-           "AbsmaxObserver", "HistObserver", "FakeQuanterWithAbsMax",
-           "QuantedLinear", "QuantedConv2D", "quant_dequant"]
+           "AbsmaxObserver", "HistObserver", "ChannelAbsmaxObserver",
+           "FakeQuanterWithAbsMax", "QuantedLinear", "QuantedConv2D",
+           "quant_dequant"]
 
 
 def _arr(x):
@@ -72,8 +73,45 @@ class AbsmaxObserver(BaseObserver):
     """Running abs-max (reference `observer.AbsmaxObserver`)."""
 
     def observe(self, x):
-        m = float(np.abs(np.asarray(_arr(x))).max())
+        # upcast at the host boundary: bf16 device arrays materialize as
+        # ml_dtypes bfloat16 ndarrays, and the float32 view keeps every
+        # downstream numpy reduction on a native dtype
+        m = float(np.abs(np.asarray(_arr(x), np.float32)).max())
         self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class ChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel running abs-max over the LAST axis.
+
+    The per-channel sibling of `AbsmaxObserver`, calibrating weight-only
+    quantization in the reference ``[..., N, K]`` layout (one channel =
+    one output row, reduced over K): `observe` accumulates an
+    elementwise running max per channel, `absmax()` returns it raw, and
+    `scales()` returns the storage-convention scale ``absmax / qmax``
+    that `nn.quant.dequant_matmul` multiplies back in-kernel (the same
+    127 / 7 formula `nn.quant.per_channel_quantize` uses). Every call
+    must observe the same leading shape."""
+
+    def observe(self, x):
+        a = np.abs(np.asarray(_arr(x), np.float32)).max(axis=-1)
+        self._scale = a if self._scale is None \
+            else np.maximum(self._scale, a)
+
+    def scale(self) -> float:
+        """Scalar view (BaseObserver contract): the max over channels."""
+        if self._scale is None:
+            raise RuntimeError("observer saw no data")
+        return float(np.max(self._scale))
+
+    def absmax(self) -> np.ndarray:
+        if self._scale is None:
+            raise RuntimeError("observer saw no data")
+        return np.asarray(self._scale, np.float32)
+
+    def scales(self) -> np.ndarray:
+        """Per-channel quantization scales ``absmax / qmax`` (f32) — the
+        `[N]`-shaped array stored alongside int8/int4 weights."""
+        return (self.absmax() / self.qmax()).astype(np.float32)
 
 
 class HistObserver(BaseObserver):
@@ -89,7 +127,7 @@ class HistObserver(BaseObserver):
         self._edges = None
 
     def observe(self, x):
-        a = np.abs(np.asarray(_arr(x))).ravel()
+        a = np.abs(np.asarray(_arr(x), np.float32)).ravel()
         hi = float(a.max()) if a.size else 1.0
         if self._hist is None:
             self._edges = np.linspace(0, max(hi, 1e-9), self.bins + 1)
@@ -126,7 +164,7 @@ class FakeQuanterWithAbsMax(Layer):
         self._scale_val = None
 
     def forward(self, x):
-        m = float(np.abs(np.asarray(_arr(x))).max())
+        m = float(np.abs(np.asarray(_arr(x), np.float32)).max())
         if self._scale_val is None:
             self._scale_val = m
         elif self.training:
@@ -190,6 +228,7 @@ class quanters:
 class observers:
     AbsmaxObserver = AbsmaxObserver
     HistObserver = HistObserver
+    ChannelAbsmaxObserver = ChannelAbsmaxObserver
 
 
 # ---------------------------------------------------------------------------

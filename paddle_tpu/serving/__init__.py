@@ -46,6 +46,7 @@ from .fault_tolerance import (AdmissionConfig, EngineStalled,
 from .fleet import FleetHandle, FleetRouter, ReplicaHandle
 from .frontend import RequestHandle, ServingFrontend
 from .metrics import ServingMetrics
+from .quant import greedy_agreement, quant_summary, quantize_engine
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
 from .slo import SLOClass, SLOConfig
 from .spec import (DraftEngineProposer, NGramProposer, Proposer,
@@ -57,5 +58,6 @@ __all__ = [
     "NGramProposer", "Proposer", "ReplicaHandle", "Request",
     "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
     "ServingFrontend", "ServingMetrics", "SLOClass", "SLOConfig",
-    "SpecDecodeConfig", "WatchdogConfig",
+    "SpecDecodeConfig", "WatchdogConfig", "greedy_agreement",
+    "quant_summary", "quantize_engine",
 ]

@@ -133,14 +133,20 @@ def _mlp_decode(params, cache, tokens, ctx_lens, tables, *, block_size):
 
 
 def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
-                      block_size):
+                      block_size, cache_scale=None):
     """Shared ragged body: packed tokens [T] + per-lane (q_len, kv_len)
     metadata. Token t embeds, writes its embedding at its absolute
     position (guard slots' writes are OOB-dropped), and conditions on
     (own embedding, masked mean of its lane's window through `tok_pos`)
-    — exactly what a sequence of decode_step calls computes."""
+    — exactly what a sequence of decode_step calls computes.
+
+    `cache_scale` ([NB, BS] f32) marks an int8-quantized embedding pool
+    (`inference/kv_quant.py`): writes quantize per slot, the gathered
+    window dequantizes right after the gather — the float pool never
+    exists. Returns (logits, cache[, cache_scale])."""
     import jax.numpy as jnp
 
+    from ..inference import kv_quant
     from ..ops.pallas.paged_attention import ragged_metadata
 
     t = tokens.shape[0]
@@ -151,15 +157,26 @@ def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
     pos = jnp.maximum(tok_pos, 0)
     blocks = tables[tok_lane, pos // block_size]             # [T]
     blocks = jnp.where(tok_pos >= 0, blocks, jnp.int32(nb))  # OOB -> drop
-    cache = cache.at[blocks, pos % block_size].set(x)
-    window = jnp.take(cache, tables, axis=0).reshape(
-        tables.shape[0], maxb * block_size, -1)              # [B, W, D]
+    if cache_scale is not None:
+        q, s = kv_quant.quantize_kv(x)                       # [T, D] / [T]
+        cache = cache.at[blocks, pos % block_size].set(q)
+        cache_scale = cache_scale.at[blocks, pos % block_size].set(s)
+        window = kv_quant.dequantize_kv(
+            jnp.take(cache, tables, axis=0),
+            jnp.take(cache_scale, tables, axis=0)).reshape(
+                tables.shape[0], maxb * block_size, -1)      # [B, W, D]
+    else:
+        cache = cache.at[blocks, pos % block_size].set(x)
+        window = jnp.take(cache, tables, axis=0).reshape(
+            tables.shape[0], maxb * block_size, -1)          # [B, W, D]
     window = jnp.take(window, tok_lane, axis=0)              # [T, W, D]
     wpos = jnp.arange(maxb * block_size, dtype=jnp.int32)
     mask = (wpos[None, :] <= tok_pos[:, None]).astype(x.dtype)
     mean = (window * mask[..., None]).sum(1) / jnp.maximum(
         mask.sum(1, keepdims=True), 1.0)                     # [T, D]
     logits = _mlp_head(params, x, mean)
+    if cache_scale is not None:
+        return logits.astype(jnp.float32), cache, cache_scale
     return logits.astype(jnp.float32), cache
 
 
@@ -174,6 +191,19 @@ def _mlp_ragged(params, cache, tokens, q_lens, kv_lens, tables, *,
     monitor.inc("serving.ragged_retraces")
     return _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens,
                              tables, block_size=block_size)
+
+
+def _mlp_ragged_q(params, cache, cache_scale, tokens, q_lens, kv_lens,
+                  tables, *, block_size):
+    """The int8-pool ragged step (`kv_bits=8`): the scale plane rides
+    (and is donated) alongside the cache."""
+    from ..framework import monitor
+
+    monitor.inc("serving.decode_retraces")  # trace-time (see _mlp_ragged)
+    monitor.inc("serving.ragged_retraces")
+    return _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens,
+                             tables, block_size=block_size,
+                             cache_scale=cache_scale)
 
 
 def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
@@ -192,13 +222,45 @@ def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
     return logits.reshape(b, s, -1), cache
 
 
+def _mlp_verify_q(params, cache, cache_scale, tokens, ctx_lens, tables, *,
+                  block_size):
+    """Verify over the int8 pool (rides the quantized ragged stack)."""
+    import jax.numpy as jnp
+
+    from ..framework import monitor
+
+    monitor.inc("serving.verify_retraces")  # trace-time only
+    b, s = tokens.shape
+    q_lens = jnp.full((b,), s, jnp.int32)
+    logits, cache, cache_scale = _mlp_ragged_stack(
+        params, cache, tokens.reshape(b * s), q_lens,
+        ctx_lens.astype(jnp.int32), tables, block_size=block_size,
+        cache_scale=cache_scale)
+    return logits.reshape(b, s, -1), cache, cache_scale
+
+
+def _mlp_mm(h, w):
+    """h [..., K] @ head weight: dense [K, N] array, or weight-only-
+    quantized {"q": [N, K], "s": [N]} / int4 {"q4": [N, K//2], "s"}
+    through the shared `nn.quant.dequant_matmul` (the same dict layout
+    the Llama engine's `_mm` consumes — `serving/quant.py` produces
+    both)."""
+    if not isinstance(w, dict):
+        return h @ w
+    from ..nn.quant import dequant_matmul
+
+    if "q4" in w:
+        return dequant_matmul(h, w["q4"], w["s"], "int4")
+    return dequant_matmul(h, w["q"], w["s"])
+
+
 def _mlp_head(params, last, mean):
     import jax
     import jax.numpy as jnp
 
     h = jnp.concatenate([last, mean], axis=-1)
-    h = jax.nn.gelu(h @ params["w1"] + params["b1"])
-    return h @ params["w2"] + params["b2"]
+    h = jax.nn.gelu(_mlp_mm(h, params["w1"]) + params["b1"])
+    return _mlp_mm(h, params["w2"]) + params["b2"]
 
 
 class MLPLMEngine:
@@ -213,7 +275,7 @@ class MLPLMEngine:
     def __init__(self, vocab_size: int = 256, hidden: int = 32,
                  max_batch_size: int = 8, num_blocks: int = 64,
                  block_size: int = 8, max_blocks_per_seq: int = 8,
-                 seed: int = 0):
+                 seed: int = 0, kv_bits: int = 16):
         import jax
         import jax.numpy as jnp
 
@@ -221,10 +283,13 @@ class MLPLMEngine:
             vocab_size=vocab_size, hidden=hidden,
             max_batch_size=max_batch_size, num_blocks=num_blocks,
             block_size=block_size, max_blocks_per_seq=max_blocks_per_seq,
-            seed=seed)
+            seed=seed, kv_bits=kv_bits)
         self.vocab_size = vocab_size
         self.max_batch_size = max_batch_size
         self.block_size = block_size
+        self.kv_bits = int(kv_bits)
+        if self.kv_bits not in (8, 16):
+            raise ValueError(f"kv_bits must be 8 or 16, got {kv_bits}")
         self.manager = BlockCacheManager(num_blocks, block_size,
                                          max_blocks_per_seq)
         rng = np.random.default_rng(seed)
@@ -239,31 +304,80 @@ class MLPLMEngine:
             "w2": init(2 * d, vocab_size),
             "b2": jnp.zeros((vocab_size,), jnp.float32),
         }
-        self.cache = jnp.zeros((num_blocks, block_size, d), jnp.float32)
+        # the "KV" pool: per-token embeddings, paged; int8 + per-slot
+        # scale plane under kv_bits=8 (inference/kv_quant.py)
+        if self.kv_bits == 8:
+            self.cache = jnp.zeros((num_blocks, block_size, d), jnp.int8)
+            self.cache_scale = jnp.zeros((num_blocks, block_size),
+                                         jnp.float32)
+            bpb = block_size * d * 1 + block_size * 4
+        else:
+            self.cache = jnp.zeros((num_blocks, block_size, d),
+                                   jnp.float32)
+            self.cache_scale = None
+            bpb = block_size * d * 4
+        self._kv_bytes_per_token = bpb / block_size
+        self.manager.set_kv_geometry(bpb, self.kv_bits)
         self._prefill = jax.jit(
             functools.partial(_mlp_prefill, block_size=block_size),
             donate_argnums=(1,))
         self._decode = jax.jit(
             functools.partial(_mlp_decode, block_size=block_size),
             donate_argnums=(1,))
-        self._verify = jax.jit(
-            functools.partial(_mlp_verify, block_size=block_size),
-            donate_argnums=(1,))
-        self._ragged = jax.jit(
-            functools.partial(_mlp_ragged, block_size=block_size),
-            donate_argnums=(1,))
+        if self.kv_bits == 8:
+            self._verify = jax.jit(
+                functools.partial(_mlp_verify_q, block_size=block_size),
+                donate_argnums=(1, 2))
+            self._ragged = jax.jit(
+                functools.partial(_mlp_ragged_q, block_size=block_size),
+                donate_argnums=(1, 2))
+            # COW copy moves the int8 block and its scale row in ONE
+            # donated executable — q + scale can never tear apart
+            self._copy_block_q = jax.jit(
+                lambda c, cs, s, d: (c.at[d].set(c[s]),
+                                     cs.at[d].set(cs[s])),
+                donate_argnums=(0, 1))
+        else:
+            self._verify = jax.jit(
+                functools.partial(_mlp_verify, block_size=block_size),
+                donate_argnums=(1,))
+            self._ragged = jax.jit(
+                functools.partial(_mlp_ragged, block_size=block_size),
+                donate_argnums=(1,))
         # COW device copy (prefix caching): one traced executable, the
         # cache donated so the copy is in-place-ish; src/dst are traced
         # int32 scalars, so repeated COWs never recompile
         self._copy_block = jax.jit(lambda c, s, d: c.at[d].set(c[s]),
                                    donate_argnums=(0,))
 
+    def kv_bytes_per_token(self) -> float:
+        """HBM bytes one cached token costs (int8 pools include the
+        scale plane) — the `serving.kv_bytes_per_token` gauge."""
+        return self._kv_bytes_per_token
+
+    def quant_info(self) -> dict:
+        """Quantization mode surface (see
+        `LlamaInferenceEngine.quant_info`); `wbits` reflects the
+        serving/quant.py weight pass (16 = unquantized)."""
+        wb = 16
+        w1 = self.params.get("w1")
+        if isinstance(w1, dict):
+            wb = 4 if "q4" in w1 else 8
+        return {"wbits": wb, "kv_bits": self.kv_bits,
+                "kv_bytes_per_token": self._kv_bytes_per_token}
+
     def copy_kv_block(self, src: int, dst: int) -> None:
         """Copy one physical cache block (`BlockCacheManager` COW hook —
         wired by the scheduler when prefix caching is on). The block's
-        whole [block_size, D] slab moves; positions past the writer's
+        whole [block_size, D] slab moves (int8 pools move the scale row
+        atomically in the same executable); positions past the writer's
         divergence point are overwritten or never attended (masked by
         context length)."""
+        if self.kv_bits == 8:
+            self.cache, self.cache_scale = self._copy_block_q(
+                self.cache, self.cache_scale, np.int32(src),
+                np.int32(dst))
+            return
         self.cache = self._copy_block(self.cache, np.int32(src),
                                       np.int32(dst))
 
@@ -287,10 +401,25 @@ class MLPLMEngine:
         fn = {"prefill": self._prefill, "decode": self._ragged,
               "ragged": self._ragged, "decode_legacy": self._decode,
               "verify": self._verify}[phase]
+        if self.kv_bits == 8:
+            if phase not in ("decode", "ragged", "verify"):
+                # no legal executable pairs the legacy fns with an int8
+                # pool (see LlamaInferenceEngine.cost_card_args)
+                raise KeyError(
+                    f"{phase!r} has no executable on a kv_bits=8 engine")
+            return fn, (self.params, self.cache, self.cache_scale)
         return fn, (self.params, self.cache)
+
+    def _require_full_kv(self, entry: str):
+        if self.kv_bits != 16:
+            raise RuntimeError(
+                f"{entry} is a legacy full-precision entry point; a "
+                f"kv_bits={self.kv_bits} engine serves through "
+                "ragged_step/verify_step (the scheduler's only dispatches)")
 
     def prefill(self, input_ids: np.ndarray, block_tables: np.ndarray,
                 lens: Optional[np.ndarray] = None) -> np.ndarray:
+        self._require_full_kv("prefill")
         ids = np.asarray(input_ids, np.int32)
         b, s = ids.shape
         if lens is None:
@@ -307,6 +436,7 @@ class MLPLMEngine:
 
     def decode_step(self, tokens: np.ndarray, context_lens: np.ndarray,
                     block_tables: np.ndarray) -> np.ndarray:
+        self._require_full_kv("decode_step")
         logits, self.cache = self._decode(
             self.params, self.cache, np.asarray(tokens, np.int32),
             np.asarray(context_lens, np.int32),
@@ -320,6 +450,13 @@ class MLPLMEngine:
         on (its own embedding, masked mean through its position) — exactly
         what a sequence of S `decode_step` calls would compute. Rides the
         ragged step (q_len == S per lane)."""
+        if self.kv_bits == 8:
+            logits, self.cache, self.cache_scale = self._verify(
+                self.params, self.cache, self.cache_scale,
+                np.asarray(tokens, np.int32),
+                np.asarray(context_lens, np.int32),
+                np.asarray(block_tables, np.int32))
+            return logits
         logits, self.cache = self._verify(
             self.params, self.cache, np.asarray(tokens, np.int32),
             np.asarray(context_lens, np.int32),
@@ -330,6 +467,14 @@ class MLPLMEngine:
                     kv_lens: np.ndarray,
                     block_tables: np.ndarray) -> np.ndarray:
         """Packed ragged step; see `EngineCore.ragged_step`."""
+        if self.kv_bits == 8:
+            logits, self.cache, self.cache_scale = self._ragged(
+                self.params, self.cache, self.cache_scale,
+                np.asarray(tokens, np.int32),
+                np.asarray(q_lens, np.int32),
+                np.asarray(kv_lens, np.int32),
+                np.asarray(block_tables, np.int32))
+            return logits
         logits, self.cache = self._ragged(
             self.params, self.cache, np.asarray(tokens, np.int32),
             np.asarray(q_lens, np.int32),

@@ -172,6 +172,22 @@ class ServingMetrics:
             monitor.set_gauge("serving.prefix_cache.hit_rate_pct",
                               round(hits / (hits + miss) * 100.0, 1))
 
+    # ---- quantized serving ----
+    def on_quant(self, info: dict):
+        """Publish the engine's quantization mode (serving/quant.py
+        `quant_summary`): weight bits, KV bits, and the per-token KV
+        byte cost — the gauges the capacity math audits against
+        (`serving.quant.{wbits,kv_bits}`, `serving.kv_bytes_per_token`).
+        Called once at scheduler bind (and again after an engine swap),
+        never on the step path."""
+        monitor.set_gauge("serving.quant.wbits", int(info.get("wbits", 16)))
+        monitor.set_gauge("serving.quant.kv_bits",
+                          int(info.get("kv_bits", 16)))
+        bpt = info.get("kv_bytes_per_token")
+        if bpt is not None:
+            monitor.set_gauge("serving.kv_bytes_per_token",
+                              round(float(bpt), 1))
+
     # ---- multi-tenant SLO classes ----
     def on_tenant_admit(self, tenant: str):
         monitor.inc(f"serving.tenant.{tenant}.admitted")

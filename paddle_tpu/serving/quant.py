@@ -1,0 +1,207 @@
+"""Quantized serving — weight-only engine quantization as a first-class
+serving mode.
+
+ROADMAP item 4 (the Gemma-on-TPU quantized serving envelope, PAPERS.md
+arxiv 2605.25645): weight-only decode is HBM-bandwidth-bound, so storing
+gemm weights as int8 (int4: two nibbles per byte) and dequantizing
+inside the kernel (`ops/pallas/quant_matmul.py` on TPU, the XLA
+dequant-fuse fallback elsewhere) cuts the bytes every decode step
+streams — and the int8 paged KV cache (`inference/kv_quant.py`,
+`kv_bits=8` on the engines) halves what every cached token holds, so
+the same HBM admits ~2x the concurrent sequences.
+
+This module is the OFFLINE pass: `quantize_engine(engine, wbits=8|4)`
+walks a built engine's parameters, calibrates per-output-channel scales
+through the `paddle_tpu.quantization` absmax observers
+(`ChannelAbsmaxObserver` — the PTQ calibration surface), and swaps each
+gemm weight for the `{"q"|"q4", "s"}` dict both engines' matmul helpers
+(`inference.llama_runner._mm`, `serving.engine._mlp_mm`) route through
+`nn.quant.dequant_matmul`. The engine's jitted entry points retrace
+ONCE on the next call (a new parameter pytree structure is a compile,
+not a steady-state retrace) and the serving loop then holds one
+executable per shape exactly as before — quantize BEFORE traffic, which
+`ServingFrontend`'s warmup does anyway.
+
+KV quantization is a CONSTRUCTION-time choice (`kv_bits=8` on
+`LlamaInferenceEngine` / `MLPLMEngine` — pool dtypes are fixed at
+build); this module's `quant_summary` reads both knobs back for the
+metrics layer (`serving.quant.{wbits,kv_bits}`,
+`serving.kv_bytes_per_token`).
+
+Accuracy yardstick: `greedy_agreement(engine, reference, ...)` — the
+teacher-forced top-1 agreement + logit-error bound of a quantized
+engine against its full-precision reference over identical contexts,
+via ONE ragged dispatch per engine (no sampling noise, no divergence
+compounding; the tie-aware margin is measured on `reference`).
+The serving_quant bench gates on it (>= 99 %), tests pin it per engine.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["quantize_engine", "quant_summary", "greedy_agreement"]
+
+# stacked llama projection keys ([L, K, N] layout) — mirrors
+# inference.llama_runner._QUANT_KEYS; the MLP engine's gemm weights are
+# plain [K, N]
+_LLAMA_KEYS = ("qkv_w", "o_w", "gate_up_w", "down_w")
+_MLP_KEYS = ("w1", "w2")
+
+
+def _observe_quantize(w_nk, wbits: int) -> Dict[str, object]:
+    """Quantize a weight already in the reference [..., N, K] layout to
+    the `{"q"|"q4", "s"}` execution dict.
+
+    Scales come from `quantization.ChannelAbsmaxObserver` (per-output-
+    channel running absmax, `scales() == absmax / qmax` — the same
+    127 / 7 formula `nn.quant.per_channel_quantize` uses), the int4 pack
+    from `nn.quant.pack_int4` (split-half, two nibbles per byte)."""
+    import jax.numpy as jnp
+
+    from ..nn.quant import pack_int4, quantize_with_scales
+    from ..quantization import ChannelAbsmaxObserver
+
+    obs = ChannelAbsmaxObserver(quant_bits=wbits)
+    obs.observe(w_nk)
+    scale = jnp.asarray(obs.scales())                # [..., N] f32
+    # the round/clip step is nn.quant's — observer scales in, the same
+    # int storage the constructor path (`per_channel_quantize`) produces
+    q = quantize_with_scales(jnp.asarray(w_nk, jnp.float32), scale, wbits)
+    if wbits == 4:
+        return {"q4": pack_int4(q), "s": scale}
+    return {"q": q, "s": scale}
+
+
+def quantize_engine(engine, wbits: int = 8):
+    """Weight-only-quantize a built serving engine IN PLACE; returns it.
+
+    Walks every gemm weight — the llama engine's stacked projections
+    (qkv/o/gate_up/down, per-layer per-out-channel scales) plus its
+    untied lm_head, or the MLP engine's w1/w2 — and swaps each for the
+    int8 / packed-int4 `{"q"|"q4", "s"}` dict the engines' matmul
+    helpers route through `nn.quant.dequant_matmul` (Pallas
+    dequant-in-VMEM gemm on aligned TPU shapes). Embeddings stay in the
+    native dtype: the embedding is a gather, not a gemm, and a tied head
+    shares its storage.
+
+    `wbits`: 8 or 4. int4 needs even in_features everywhere (the pack
+    is two values per byte). Raises on an engine whose weights are
+    already quantized — re-quantizing quantized values would compound
+    error silently."""
+    if wbits not in (4, 8):
+        raise ValueError(f"wbits must be 4 or 8, got {wbits}")
+    import jax.numpy as jnp
+
+    params = getattr(engine, "params", None)
+    if not isinstance(params, dict):
+        raise TypeError(f"{type(engine).__name__} has no params dict to "
+                        "quantize")
+    if "qkv_w" in params:
+        keys = _LLAMA_KEYS
+    elif "w1" in params:
+        keys = _MLP_KEYS
+    else:
+        raise TypeError(
+            f"{type(engine).__name__}: unrecognized parameter layout "
+            f"(expected llama projection keys or MLP w1/w2)")
+    for key in keys:
+        if isinstance(params[key], dict):
+            raise ValueError(
+                f"engine weight {key!r} is already quantized — "
+                "re-quantizing would compound error")
+    new = dict(params)
+    for key in keys:
+        w = params[key].astype(jnp.float32)
+        # [L, K, N] stacked / [K, N] flat -> [..., N, K] reference layout
+        w_nk = jnp.swapaxes(w, -1, -2)
+        new[key] = _observe_quantize(w_nk, wbits)
+    head = params.get("lm_head")
+    if head is not None and not isinstance(head, dict):
+        # untied head [H, V] -> [V, H]: the vocab gemm is the largest
+        # single matmul of a decode step
+        new["lm_head"] = _observe_quantize(
+            jnp.swapaxes(head.astype(jnp.float32), -1, -2), wbits)
+    engine.params = new
+    engine.weight_only = f"int{wbits}"
+    return engine
+
+
+def quant_summary(engine) -> Dict[str, object]:
+    """The quantization mode of an engine, for metrics/reports:
+    `{"wbits", "kv_bits", "kv_bytes_per_token"}` (16 = unquantized
+    weights / native-dtype KV). Falls back to the defaults for engines
+    without a `quant_info` hook."""
+    info = getattr(engine, "quant_info", None)
+    if info is None:
+        return {"wbits": 16, "kv_bits": 16, "kv_bytes_per_token": None}
+    return dict(info())
+
+
+def greedy_agreement(engine, reference, prompts) -> Dict[str, float]:
+    """Teacher-forced greedy top-1 agreement of `engine` (the quantized
+    candidate) against `reference` (the full-precision ground truth).
+
+    ARGUMENT ORDER MATTERS: the tie-aware margin is measured on
+    `reference`'s logits — swapping the arguments redefines the metric.
+
+    Feeds each prompt through ONE `ragged_step` dispatch per engine
+    (every token scores against the same committed context — no
+    sampling, no divergence compounding, exactly the decode-path
+    executable serving runs). Returns:
+
+    - ``agreement`` — strict argmax-match fraction over all positions;
+    - ``agreement_tie_aware`` — argmax match OR a RESOLUTION TIE: the
+      reference engine's margin between its own top-1 and the quantized
+      engine's pick is within twice the measured per-position logit
+      perturbation, i.e. the flip is explainable by quantization
+      resolution alone (bounded-perturbation argmax stability — on
+      near-degenerate logits strict agreement measures coin flips, not
+      quantization damage). The >= 99 % acceptance gate reads this one;
+      strict rides as evidence;
+    - ``max_logit_err`` / ``mean_logit_err`` — the logit-error bounds.
+
+    Both engines' pools are used from-empty and freed afterwards; each
+    prompt must fit one `max_blocks_per_seq` allocation."""
+    agree = agree_tie = total = 0
+    max_err = err_sum = 0.0
+    for pid, prompt in enumerate(prompts):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rows = []
+        for eng in (engine, reference):
+            seq = 1_000_000 + pid     # out of any live request id space
+            eng.manager.allocate(seq, len(prompt))
+            try:
+                tables = eng.manager.block_table_array([seq])
+                T = len(prompt)
+                logits = np.asarray(eng.ragged_step(
+                    prompt, np.array([T], np.int32),
+                    np.array([T], np.int32), tables))[:T]
+            finally:
+                # a raising dispatch must not strand the synthetic lease
+                eng.manager.free(seq)
+            rows.append(logits.astype(np.float64))
+        la, lb = rows                        # candidate / reference
+        top_a = la.argmax(-1)
+        top_b = lb.argmax(-1)
+        match = top_a == top_b
+        eps = np.abs(la - lb).max(-1)                  # [T] perturbation
+        # reference margin between ITS top-1 and the candidate the
+        # other engine picked: within 2*eps the flip is a tie at the
+        # representation's resolution, not a real disagreement
+        idx = np.arange(la.shape[0])
+        margin = lb[idx, top_b] - lb[idx, top_a]
+        tie = margin <= 2.0 * eps
+        agree += int(match.sum())
+        agree_tie += int((match | tie).sum())
+        total += la.shape[0]
+        max_err = max(max_err, float(np.abs(la - lb).max()))
+        err_sum += float(np.abs(la - lb).mean()) * la.shape[0]
+    return {
+        "agreement": agree / max(total, 1),
+        "agreement_tie_aware": agree_tie / max(total, 1),
+        "max_logit_err": max_err,
+        "mean_logit_err": err_sum / max(total, 1),
+        "positions": total,
+    }

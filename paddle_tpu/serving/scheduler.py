@@ -338,6 +338,17 @@ class Scheduler:
             self._prefix_tree = RadixPrefixCache(mgr)
             mgr.set_reclaimer(self._prefix_tree)
             mgr.set_cow_hook(getattr(self.engine, "copy_kv_block", None))
+        # publish the engine's quantization mode (wbits/kv_bits/
+        # kv_bytes_per_token gauges) — bind-time, not per-step; an
+        # engine swap re-runs this with the fresh engine's mode
+        info = getattr(self.engine, "quant_info", None)
+        if info is not None:
+            try:
+                self.metrics.on_quant(info())
+            except Exception:
+                # bind must survive a broken hook, but not silently:
+                # unset quant gauges + this counter point at the cause
+                _monitor.inc("serving.quant_info_errors")
 
     # ---- waiting-queue bookkeeping (cost-accounted) ----
     def _queue_push(self, req: Request, front: bool = False):

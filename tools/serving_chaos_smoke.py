@@ -155,6 +155,67 @@ def check_contract(name, fe, handles, reference, expect_failed=None):
     return report
 
 
+def make_quant_engine():
+    """Quantized twin of `make_engine`: int8 KV pool + int8 weight-only
+    gemms (PR 14, serving/quant.py)."""
+    from paddle_tpu.serving import MLPLMEngine, quantize_engine
+
+    return quantize_engine(
+        MLPLMEngine(vocab_size=VOCAB, hidden=16, max_batch_size=4,
+                    num_blocks=48, block_size=4, max_blocks_per_seq=8,
+                    kv_bits=8), wbits=8)
+
+
+def quant_run(arm=None):
+    from paddle_tpu.serving import (ServingFrontend, ServingMetrics,
+                                    WatchdogConfig)
+
+    ServingMetrics.reset_monitor()
+    fe = ServingFrontend(
+        make_quant_engine(),
+        watchdog=WatchdogConfig(step_retries=2, max_restarts=MAX_RESTARTS),
+        engine_factory=make_quant_engine, stall_after=256)
+    handles = [fe.submit(p, max_new_tokens=6) for p in trace()]
+    if arm is not None:
+        arm(handles)
+    fe.run_until_idle(max_steps=4000)
+    return fe, handles
+
+
+def quant_chaos():
+    """Quantized-pool pass: the `serve.cache` fault fires against an
+    int8 KV pool (per-slot scale planes riding every block). The
+    terminal-status and leak contracts must hold bit-for-bit like the
+    full-precision pool's — the scale plane is part of the block, so a
+    leaked or double-freed block would show up identically — and the
+    fragmentation telemetry must report the quantized byte geometry
+    (kv_bits/bytes_per_block, the PR 14 capacity-audit fields)."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import RequestStatus
+
+    faults.clear()
+    _, ref_h = quant_run()
+    assert all(h.status is RequestStatus.FINISHED for h in ref_h), \
+        "quantized fault-free reference did not finish"
+    reference = [h.tokens for h in ref_h]
+
+    faults.clear()
+    fe, hs = quant_run(
+        arm=lambda _h: faults.inject("serve.cache", after_n=6, times=1))
+    faults.clear()
+    report = check_contract("serve.cache:int8_pool", fe, hs, reference,
+                            expect_failed=["engine_fault:cache"])
+    frag = fe.scheduler.engine.manager.fragmentation()
+    assert frag["kv_bits"] == 8, frag
+    assert frag["bytes_per_block"] and frag["pool_bytes"], frag
+    assert monitor.get("serving.quant.kv_bits") == 8
+    assert monitor.get("serving.quant.wbits") == 8
+    report["kv_bits"] = frag["kv_bits"]
+    report["bytes_per_block"] = frag["bytes_per_block"]
+    return report
+
+
 def fleet_trace():
     """Deterministic Poisson-ish burst: step index -> requests arriving
     then (seeded rng; no clocks, no sleeps)."""
@@ -435,6 +496,10 @@ def main():
     # prefix-cache pass: serve.cache fault while blocks are shared
     reports.append(prefix_chaos())
 
+    # quantized-pool pass: serve.cache fault against int8 KV + scale
+    # planes (PR 14) — same zero-leak / terminal-status contract
+    reports.append(quant_chaos())
+
     # fleet-wide pass: unkilled reference, then the mid-burst replica kill
     faults.clear()
     ref_router, ref_handles = fleet_run()
@@ -453,6 +518,8 @@ def main():
         "contract": "all requests terminal, restarts <= budget, "
                     "0 leaked blocks, survivor greedy parity, "
                     "prefix cache: shared-block fault -> no double-free, "
+                    "int8 KV pool: cache fault -> zero leaks, quantized "
+                    "byte geometry in telemetry, "
                     "fleet: replica kill -> relocation parity, "
                     "relocations <= budget, survivors leak-free",
     }))
