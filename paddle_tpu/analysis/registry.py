@@ -55,6 +55,10 @@ HOT_PATHS = {
     # host conversions allowed
     ("serving/engine.py", "MLPLMEngine.copy_kv_block"),
     ("inference/llama_runner.py", "LlamaInferenceEngine.copy_kv_block"),
+    # the elastic supervisor's per-step heartbeat: one membership-store
+    # write per train step — a per-call device_put/import/extra blocking
+    # call here lands on EVERY step of every supervised training run
+    ("resilience/elastic_train.py", "ElasticTrainSupervisor._beat"),
 }
 
 # ---------------------------------------------------------------------------
@@ -126,6 +130,7 @@ TRACED_FN_EXTRA: set = set()
 # ---------------------------------------------------------------------------
 THREADED_MODULES = (
     "resilience/checkpoint_manager.py",
+    "resilience/elastic_train.py",   # heartbeat ticker + supervisor
     "resilience/faults.py",
     "serving/fleet.py",
     "distributed/elastic/",

@@ -14,6 +14,13 @@ stuck collective's kind/group/bytes plus the recent comm-trace ring
 (`observability.comms.dump_watchdog_trip`). The clock and the wait
 primitive are injectable so tests exercise the trip path with zero
 sleeps.
+
+Under an escalation supervisor (``on_trip=``, ISSUE 15), a trip hands
+the typed :class:`CollectiveStalled` to the supervisor first; when the
+supervisor can handle it in-process (the dispatch returned — fence the
+mesh epoch, re-form, resume) the kill/log action is suppressed, and
+when it cannot (the caller is still blocked inside the collective) the
+action fires as the last resort.
 """
 from __future__ import annotations
 
@@ -32,7 +39,27 @@ flags.define_flag("comm_timeout_action", "kill",
                   "watchdog action on timeout: 'kill' (exit 124, launcher "
                   "restarts) or 'log'")
 
-__all__ = ["CommWatchdog", "watchdog_guard"]
+__all__ = ["CollectiveStalled", "CommWatchdog", "watchdog_guard"]
+
+
+class CollectiveStalled(RuntimeError):
+    """A collective exceeded its watchdog timeout under an escalation
+    supervisor. Where the classic watchdog answer to a hang is
+    dump-forensics-then-``os._exit(124)`` (let the launcher relaunch),
+    a supervised training loop wants the hang surfaced as a typed,
+    catchable event it can fence/re-form around — the elastic train
+    supervisor funnels this into ``WorldChanged``."""
+
+    def __init__(self, op_name: str, meta: Optional[dict] = None,
+                 elapsed_s: Optional[float] = None):
+        self.op_name = op_name
+        self.meta = dict(meta or {})
+        self.elapsed_s = elapsed_s
+        super().__init__(
+            f"collective '{op_name}' stalled"
+            + (f" for {elapsed_s:.1f}s" if elapsed_s is not None else "")
+            + (f" (bytes={self.meta['bytes']})"
+               if "bytes" in self.meta else ""))
 
 
 class CommWatchdog:
@@ -42,17 +69,35 @@ class CommWatchdog:
     (payload bytes, group id); `clock`/`wait` are injectable for
     zero-sleep tests — `wait(timeout)` must return True when the op
     finished in time and False on timeout (the `threading.Event.wait`
-    contract)."""
+    contract).
+
+    ``on_trip`` is the escalation hook: a trip still produces the full
+    diagnostics (counter + flight dump + stacks) and then calls
+    ``on_trip(CollectiveStalled(...))``. The hook returns whether the
+    stall was **handled** — True suppresses the configured kill/log
+    action (the supervisor will raise the typed stall at its step
+    boundary and re-form in-process); False/None falls through to the
+    action, because a hook that cannot actually unwedge the blocked
+    caller must not also disarm the watchdog's last resort (a genuinely
+    hung collective still needs the exit-124 → launcher-relaunch path —
+    the supervisor resumes from its checkpoint on the other side). The
+    hook runs on whatever thread drives the trip: the watchdog thread
+    for a real hang, the caller's thread when a test drives `_watch()`
+    synchronously."""
 
     def __init__(self, op_name: str, timeout: Optional[float] = None,
                  action: Optional[str] = None, meta: Optional[dict] = None,
                  clock: Callable[[], float] = time.time,
-                 wait: Optional[Callable[[float], bool]] = None):
+                 wait: Optional[Callable[[float], bool]] = None,
+                 on_trip: Optional[Callable[[CollectiveStalled], None]]
+                 = None):
         self.op_name = op_name
         self.timeout = (flags.flag_value("comm_timeout_s")
                         if timeout is None else float(timeout))
         self.action = action or flags.flag_value("comm_timeout_action")
         self.meta = dict(meta or {})
+        self.on_trip = on_trip
+        self.tripped = False
         self._clock = clock
         self._done = threading.Event()
         self._wait = wait if wait is not None else self._done.wait
@@ -86,6 +131,7 @@ class CommWatchdog:
             else self._clock()
         elapsed = self._clock() - started
         rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+        self.tripped = True
         monitor.inc("comm.watchdog_trips")
         try:
             from ... import observability as _obs
@@ -107,6 +153,29 @@ class CommWatchdog:
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))
         sys.stderr.flush()
+        if self.on_trip is not None:
+            # escalation first: the supervisor decides whether dying can
+            # mean fence + re-form (handled) — only a HANDLED stall
+            # suppresses the action; an unhandled one (caller still
+            # blocked in the collective) falls through below. A hook
+            # that RAISES counts as unhandled: on the watchdog thread
+            # the exception would otherwise kill the thread before the
+            # exit-124 last resort — the exact wedge escalation exists
+            # to prevent.
+            handled, hook_exc = False, None
+            try:
+                handled = bool(self.on_trip(
+                    CollectiveStalled(self.op_name, dict(self.meta),
+                                      elapsed_s=elapsed)))
+            except BaseException as e:  # noqa: BLE001 — arbitrary hooks
+                hook_exc = e
+            if handled:
+                return
+            if self.action == "kill":
+                os._exit(124)
+            if hook_exc is not None:
+                raise hook_exc  # surfaces on a synchronous drive
+            return
         if self.action == "kill":
             # exit 124 so the launcher's watcher treats it as a failure
             # and (elastic mode) relaunches — the NCCL-watchdog abort path
@@ -122,10 +191,12 @@ class CommWatchdog:
 
 def watchdog_guard(op_name: str, timeout: Optional[float] = None,
                    action: Optional[str] = None,
-                   meta: Optional[dict] = None) -> CommWatchdog:
+                   meta: Optional[dict] = None,
+                   on_trip=None) -> CommWatchdog:
     """Context manager guarding one collective call:
 
     with watchdog_guard("all_reduce", meta={"bytes": payload_bytes}):
         <blocking collective>
     """
-    return CommWatchdog(op_name, timeout, action, meta=meta)
+    return CommWatchdog(op_name, timeout, action, meta=meta,
+                        on_trip=on_trip)

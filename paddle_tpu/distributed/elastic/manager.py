@@ -64,18 +64,23 @@ class ElasticManager:
         self.store.deregister(pod_id, incarnation=incarnation)
 
     def reap_stale(self, timeout_s: Optional[float] = None,
-                   now: Optional[float] = None) -> List[str]:
+                   now: Optional[float] = None,
+                   return_payloads: bool = False):
         """Heartbeat-timeout sweep: deregister pods that stopped
         heartbeating without an explicit `report_dead` (host gone, network
         partition). Returns the reaped pod ids and bumps the
-        ``elastic.reaped`` counter. Defaults to the store's TTL."""
+        ``elastic.reaped`` counter. Defaults to the store's TTL. With
+        ``return_payloads=True`` returns ``(ids, {id: last_payload})`` so
+        the caller can report the lost pods' final step/loss."""
         from ...framework import monitor
 
-        reaped = self.store.reap_stale(
-            self.store.ttl if timeout_s is None else timeout_s, now=now)
+        out = self.store.reap_stale(
+            self.store.ttl if timeout_s is None else timeout_s, now=now,
+            return_payloads=return_payloads)
+        reaped = out[0] if return_payloads else out
         if reaped:
             monitor.inc("elastic.reaped", len(reaped))
-        return reaped
+        return out
 
     def ranks(self) -> List[str]:
         """Dense rank order over live pods (reference rank regeneration:
@@ -92,16 +97,33 @@ class ElasticManager:
         None if the deadline passes below min_nodes. Time flows only
         through the injected ``clock``/``sleep``, so membership tests
         drive the full wait loop with zero real sleeps."""
+        return self.wait_for_quorum(self.min_nodes, deadline_s)
+
+    def wait_for_quorum(self, min_world: int, deadline_s: float = 30.0
+                        ) -> Optional[List[str]]:
+        """Survivor-consensus barrier for elastic re-formation: block
+        until at least ``min_world`` pods are alive (any world size at or
+        above the floor is trainable — unlike :meth:`wait_for_world`,
+        which insists on the manager's configured range), let membership
+        stabilize so simultaneous losses/joins coalesce into ONE reform,
+        and return the rank-ordered surviving world. None when the
+        deadline passes still below quorum — the caller must abort the
+        job (training below quorum would silently change the math the
+        operator signed up for). Zero-sleep testable through the
+        injected ``clock``/``sleep``."""
+        if min_world < 1:
+            raise ValueError(f"min_world must be >= 1, got {min_world}")
         end = self._clock() + deadline_s
-        while self._clock() < end:
+        while True:
             pods = self.ranks()
-            if len(pods) >= self.min_nodes:
+            if len(pods) >= min_world:
                 self._sleep(self.stabilize_s)  # coalesce concurrent changes
                 again = self.ranks()
-                if len(again) >= self.min_nodes:
+                if len(again) >= min_world:
                     return again
+            if self._clock() >= end:
+                return None
             self._sleep(0.2)
-        return None
 
     def scale_changed(self, current: List[str]) -> Tuple[bool, List[str]]:
         """(changed?, new rank order) vs the running assignment."""

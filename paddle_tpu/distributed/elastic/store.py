@@ -22,14 +22,29 @@ __all__ = ["MembershipStore"]
 
 class MembershipStore:
     def __init__(self, path: str, ttl: float = 10.0,
-                 lock_timeout: float = 30.0):
+                 lock_timeout: float = 30.0, clock=time.time):
+        """``clock`` is injectable (the `framework/retry.py` pattern): the
+        elastic train supervisor drives registration, heartbeats, lease
+        expiry, and reap sweeps through ONE fake clock so the whole
+        detect-by-silence path tests with zero real sleeps."""
         self.path = path
         self.ttl = float(ttl)
         self.lock_timeout = float(lock_timeout)
+        self._clock = clock
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
 
     def _locked(self, mutate):
-        """Run `mutate(pods_dict) -> result` under an exclusive file lock.
+        """Run `mutate(pods_dict) -> result` under an exclusive file
+        lock; the store file is rewritten unconditionally."""
+        return self._locked_rw(lambda pods: (mutate(pods), True))
+
+    def _locked_rw(self, mutate):
+        """Run `mutate(pods_dict) -> (result, changed)` under an
+        exclusive file lock, rewriting the store only when ``changed``
+        — the sweep paths (`reap_stale`, `alive`) run every train step
+        / router tick and usually delete nothing; re-serializing and
+        `os.replace`-ing the whole file for a no-op would double store
+        write traffic on a shared filesystem.
 
         The lock is taken non-blocking through `framework.retry` (backoff
         + deadline + `elastic.lock_retries` counter) instead of the old
@@ -50,11 +65,12 @@ class MembershipStore:
                         pods = json.load(f)
                 except (FileNotFoundError, json.JSONDecodeError):
                     pods = {}
-                result = mutate(pods)
-                tmp = self.path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(pods, f)
-                os.replace(tmp, self.path)
+                result, changed = mutate(pods)
+                if changed:
+                    tmp = self.path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(pods, f)
+                    os.replace(tmp, self.path)
                 return result
             finally:
                 fcntl.flock(lk, fcntl.LOCK_UN)
@@ -76,7 +92,7 @@ class MembershipStore:
             prev = pods.get(pod_id) or {}
             incarnation = int(prev.get("incarnation", 0)) + 1
             pods[pod_id] = {"endpoint": endpoint,
-                            "last_heartbeat": time.time(),
+                            "last_heartbeat": self._clock(),
                             "incarnation": incarnation}
             if payload is not None:
                 pods[pod_id]["payload"] = payload
@@ -109,7 +125,7 @@ class MembershipStore:
         refreshes the per-pod load report in the same write. Returns the
         pod ids whose heartbeat was rejected as stale (also counted on
         the ``elastic.stale_heartbeats`` monitor counter)."""
-        now = time.time()
+        now = self._clock()
 
         def mutate(pods):
             stale = []
@@ -156,37 +172,45 @@ class MembershipStore:
 
         return self._locked(mutate)
 
-    def reap_stale(self, timeout_s: float,
-                   now: Optional[float] = None) -> List[str]:
+    def reap_stale(self, timeout_s: float, now: Optional[float] = None,
+                   return_payloads: bool = False):
         """Deregister every pod whose last heartbeat is older than
         ``timeout_s`` and return their ids (sorted). This is the sweep a
         launcher runs when a pod stops heartbeating without ever calling
         `deregister` — e.g. its host vanished. ``now`` is injectable so
-        tests sweep deterministically with zero sleeps."""
-        t = time.time() if now is None else float(now)
+        tests sweep deterministically with zero sleeps.
+
+        With ``return_payloads=True`` returns ``(ids, payloads)`` where
+        ``payloads`` maps each reaped pod to the last load report its
+        final heartbeat carried (None if it never sent one) — the elastic
+        train supervisor puts the lost pods' final step/loss in the
+        reform flight dump."""
+        t = self._clock() if now is None else float(now)
 
         def mutate(pods):
             stale = sorted(
                 k for k, v in pods.items()
                 if t - v.get("last_heartbeat", 0) > float(timeout_s))
+            last = {k: pods[k].get("payload") for k in stale}
             for k in stale:
                 del pods[k]
-            return stale
+            return (stale, last), bool(stale)
 
-        return self._locked(mutate)
+        stale, last = self._locked_rw(mutate)
+        return (stale, last) if return_payloads else stale
 
     def alive(self) -> Dict[str, dict]:
         """Live pods; entries past the TTL are expired (lease timeout)."""
-        now = time.time()
+        now = self._clock()
 
         def mutate(pods):
             dead = [k for k, v in pods.items()
                     if now - v.get("last_heartbeat", 0) > self.ttl]
             for k in dead:
                 del pods[k]
-            return dict(pods)
+            return dict(pods), bool(dead)
 
-        return self._locked(mutate)
+        return self._locked_rw(mutate)
 
     def clear(self) -> None:
         self._locked(lambda pods: pods.clear())

@@ -31,7 +31,7 @@ from typing import List, Optional
 
 __all__ = ["configure", "flight_dir", "request_event", "dispatch_span",
            "events", "flight_events", "dump_flight", "write_flight_file",
-           "chrome_events", "reset"]
+           "dump_elastic_reform", "chrome_events", "reset"]
 
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=4096)
@@ -146,6 +146,25 @@ def dump_flight(reason: str, directory: Optional[str] = None) -> Optional[str]:
     return write_flight_file(reason,
                              {"reason": reason, "events": len(evs)},
                              evs, directory)
+
+
+def dump_elastic_reform(info: dict, lost_pods: dict,
+                        directory: Optional[str] = None) -> Optional[str]:
+    """Mesh re-formation forensics (always-on, like the comm-watchdog
+    trip dump): one ``flight_elastic_reform_*.jsonl`` naming the lost
+    pods with the final heartbeat payload each delivered (last
+    step/loss/step-wall), the old and new worlds, the fenced epoch, and
+    the step training resumed from — followed by the recent timeline
+    ring. Never raises into the recovery path."""
+    lines = [{"lost_pod": pod, "final_payload": payload}
+             for pod, payload in sorted(lost_pods.items())]
+    with _lock:
+        lines += [e.as_dict() for e in list(_ring)[-64:]]
+    return write_flight_file(
+        "elastic_reform",
+        dict({"reason": "elastic_reform",
+              "lost_pods": sorted(lost_pods)}, **info),
+        lines, directory)
 
 
 def chrome_events(base: Optional[float] = None) -> List[dict]:

@@ -394,6 +394,7 @@ class Profiler:
         lines.extend(self._serving_summary_lines())
         lines.extend(self._fleet_summary_lines())
         lines.extend(self._resilience_summary_lines())
+        lines.extend(self._elastic_summary_lines())
         lines.extend(self._observability_summary_lines())
         lines.extend(self._mesh_summary_lines())
         return "\n".join(lines)
@@ -464,6 +465,29 @@ class Profiler:
         ]
         if trips:
             lines.append("  trip reasons: " + cls._kv_join(trips))
+        return lines
+
+    @classmethod
+    def _elastic_summary_lines(cls):
+        """Elastic multichip training stats (resilience/elastic_train.py):
+        mesh re-formations with lost-pod count, the current world size,
+        the last kill-to-training-again recovery wall, and the fencing
+        evidence (stale heartbeats rejected after an epoch bump)."""
+        from ..framework import monitor
+
+        snap = monitor.snapshot(include_histograms=False)
+        g = lambda k: snap.get(k, 0)  # noqa: E731
+        if not (g("elastic.reforms") or g("elastic.lost_pods")):
+            return []
+        lines = [
+            "",
+            f"Elastic: {g('elastic.reforms')} mesh re-formations "
+            f"({g('elastic.lost_pods')} pods lost), "
+            f"world size {g('elastic.world_size')}, "
+            f"last recovery {g('elastic.recovery_ms')} ms",
+            f"  stale heartbeats rejected {g('elastic.stale_heartbeats')}, "
+            f"reaped {g('elastic.reaped')}",
+        ]
         return lines
 
     @classmethod

@@ -14,15 +14,28 @@ into a testable survive-the-failure subsystem:
   budget, and a SIGTERM emergency-checkpoint hook;
 - :mod:`faults` — the deterministic fault-injection registry that makes
   every failure path above exercisable in tests
-  (``faults.inject("ckpt.write", after_n=3)``).
+  (``faults.inject("ckpt.write", after_n=3)``);
+- :class:`ElasticTrainSupervisor` (`elastic_train.py`) — the elastic
+  multichip loop composing all of the above with
+  `distributed/elastic/` membership: coordinated failure detection
+  (per-step heartbeats, watchdog escalation, collective aborts),
+  epoch-fenced mesh re-formation under quorum, and
+  reshard-on-resume so a world-N checkpoint restores at world M.
 
 See ``docs/RESILIENCE.md`` for the failure matrix and the checkpoint
 directory layout contract.
 """
 from . import faults
 from .checkpoint_manager import CheckpointManager
+from .elastic_train import (CollectiveAborted, CollectiveStalled,
+                            ElasticTrainSupervisor, EmulatedTrainable,
+                            QuorumLost, ReformBudgetExceeded, WorldChanged,
+                            make_emulated_trainable)
 from .guard import (NoValidCheckpoint, Preempted, RestartBudgetExceeded,
                     StepGuard)
 
 __all__ = ["CheckpointManager", "StepGuard", "RestartBudgetExceeded",
-           "NoValidCheckpoint", "Preempted", "faults"]
+           "NoValidCheckpoint", "Preempted", "faults",
+           "ElasticTrainSupervisor", "EmulatedTrainable",
+           "make_emulated_trainable", "WorldChanged", "CollectiveAborted",
+           "CollectiveStalled", "QuorumLost", "ReformBudgetExceeded"]

@@ -366,11 +366,23 @@ class CheckpointManager:
 
     # -- load ---------------------------------------------------------------
     def load(self, path: str, model=None, optimizer=None, scaler=None,
-             state_dict: Optional[dict] = None) -> SimpleNamespace:
+             state_dict: Optional[dict] = None,
+             placements: Optional[Dict[str, object]] = None
+             ) -> SimpleNamespace:
         """Restore ``path`` into the given objects IN PLACE (model tensors
         resharded to their current placement, optimizer accumulators
         rebuilt exactly, RNG + scaler state reset) and return
-        ``SimpleNamespace(step, extras)``."""
+        ``SimpleNamespace(step, extras)``.
+
+        ``placements`` is the world-shape-aware path (ISSUE 15): a dict
+        mapping state keys to target `jax.sharding.Sharding`s. Each named
+        destination tensor is first placed onto its target sharding, so a
+        checkpoint saved at world N restores at world M != N — the loader
+        (`distributed/checkpoint/load_state_dict.py`) computes per-
+        destination-shard overlap with the SAVED shard layout and
+        re-slices on load; each device receives only its slice of the
+        new world's partitioning. Keys are the caller's state keys (no
+        ``model.`` prefix)."""
         # the manager's own async writer bypasses save_state_dict's pending
         # registry, so loading the path an async save() just returned must
         # join it here (error deferred, not lost — next save()/wait() raises)
@@ -380,6 +392,8 @@ class CheckpointManager:
         dest: Dict[str, object] = {}
         src = state_dict if state_dict is not None else (
             model.state_dict() if model is not None else {})
+        if placements:
+            self._apply_placements(src, placements)
         for k, t in src.items():
             dest[_MODEL + k] = t  # live tensors: loaded in place, resharded
         meta = _read_metadata(path)
@@ -418,13 +432,40 @@ class CheckpointManager:
         return SimpleNamespace(step=int(extra["step"]),
                                extras=extra.get("extras", {}))
 
+    @staticmethod
+    def _apply_placements(src: Dict[str, object],
+                          placements: Dict[str, object]) -> None:
+        """Re-place destination templates onto their target shardings
+        BEFORE the load assembles bytes: `load_state_dict` reshards to
+        whatever sharding the destination array carries, so moving the
+        template IS choosing the restored world shape. Unknown keys are
+        an error — a typo here would silently restore the old layout."""
+        import jax
+
+        missing = [k for k in placements if k not in src]
+        if missing:
+            raise KeyError(f"placements name keys absent from the state "
+                           f"dict: {missing}")
+        for k, sharding in placements.items():
+            t = src[k]
+            arr = t._data if isinstance(t, Tensor) else t
+            placed = jax.device_put(jax.numpy.asarray(arr), sharding)
+            if isinstance(t, Tensor):
+                t._data = placed
+            else:
+                src[k] = placed
+
     def restore_latest(self, model=None, optimizer=None, scaler=None,
-                       state_dict: Optional[dict] = None):
+                       state_dict: Optional[dict] = None,
+                       placements: Optional[Dict[str, object]] = None):
         """`latest_valid()` + `load()`; None when no valid checkpoint
-        exists."""
+        exists. ``placements`` selects the restored world shape (see
+        :meth:`load`) — the reshard-on-resume entry point the elastic
+        train supervisor uses after a mesh re-formation."""
         found = self.latest_valid()
         if found is None:
             return None
         _, path = found
         return self.load(path, model=model, optimizer=optimizer,
-                         scaler=scaler, state_dict=state_dict)
+                         scaler=scaler, state_dict=state_dict,
+                         placements=placements)

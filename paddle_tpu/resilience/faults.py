@@ -42,6 +42,17 @@ mid-burst replica-kill the fleet chaos smoke drives) and
 ``fleet.submit`` (per placement attempt; a raise models an unreachable
 replica and exercises submit failover). See docs/SERVING.md "Fleet
 routing & replica failure".
+
+Elastic training sites (`resilience/elastic_train.py`): ``train.step``
+(per supervised train step; ``action="flag"`` kills the busiest
+emulated pod mid-step so its collective aborts — the
+`tools/train_chaos_smoke.py` scenario; a raised `CollectiveAborted` /
+`CollectiveStalled` exc models the failure directly), ``elastic.beat``
+(flag: the victim pod's heartbeat silently stops reaching the store,
+driving the reap-detection path), ``elastic.reform`` /
+``elastic.reshard`` (failures inside recovery itself — before quorum
+and before the checkpoint reshard respectively). See
+docs/RESILIENCE.md "Elastic training".
 """
 from __future__ import annotations
 

@@ -64,12 +64,26 @@ class StepGuard:
                  window: int = 8, threshold: float = 10.0,
                  max_restarts: int = 3, max_scaler_skips: Optional[int] = 20,
                  save_every: Optional[int] = None,
-                 exit_on_preempt: bool = True):
+                 exit_on_preempt: bool = True,
+                 state_dict=None, placements=None,
+                 escalate: tuple = ()):
+        """``state_dict``/``placements``: guard a functional train state
+        (dict of sharded Tensors) instead of a model/optimizer pair —
+        saves and rollbacks flow the dict (with its target shardings)
+        through the manager, the elastic supervisor's path. ``escalate``
+        names exception types the guard must NOT treat as a trip-and-
+        rollback anomaly: mesh-level failures (a lost pod's aborted
+        collective, a watchdog stall) re-raise to the supervisor that
+        owns the fence/re-form/reshard response — rolling the surviving
+        state back cannot cure a dead host."""
         self.step_fn = step_fn
         self.manager = manager
         self.model = model
         self.optimizer = optimizer
         self.scaler = scaler
+        self.state_dict = state_dict
+        self.placements = placements
+        self.escalate = tuple(escalate)
         self.window = int(window)
         self.threshold = float(threshold)
         self.max_restarts = int(max_restarts)
@@ -114,6 +128,8 @@ class StepGuard:
             out = self.step_fn(step_idx, *args, **kwargs)
         except (Preempted, RestartBudgetExceeded, NoValidCheckpoint):
             raise
+        except self.escalate:
+            raise               # mesh-level failure: the supervisor's call
         except Exception as exc:
             return self._trip("exception", repr(exc))
         loss, grad_norm = out if isinstance(out, tuple) else (out, None)
@@ -166,7 +182,8 @@ class StepGuard:
     def _maybe_periodic_save(self, step_idx: int) -> None:
         if self.save_every and (step_idx + 1) % self.save_every == 0:
             self.manager.save(step_idx, model=self.model,
-                              optimizer=self.optimizer, scaler=self.scaler)
+                              optimizer=self.optimizer, scaler=self.scaler,
+                              state_dict=self.state_dict)
 
     def _spikes(self, value: float, window) -> bool:
         if len(window) < self.window:
@@ -188,7 +205,9 @@ class StepGuard:
                 f"{self.max_restarts}); last: {reason}: {detail}")
         res = self.manager.restore_latest(model=self.model,
                                           optimizer=self.optimizer,
-                                          scaler=self.scaler)
+                                          scaler=self.scaler,
+                                          state_dict=self.state_dict,
+                                          placements=self.placements)
         if res is None:
             raise NoValidCheckpoint(
                 f"guard tripped ({reason}: {detail}) but no valid "
@@ -239,6 +258,7 @@ class StepGuard:
             self.manager.emergency_save(
                 self.last_step, model=self.model,
                 optimizer=self.optimizer, scaler=self.scaler,
+                state_dict=self.state_dict,
                 extras={"preempt_signal": int(signum)})
         if self.exit_on_preempt:
             raise Preempted()

@@ -159,6 +159,97 @@ class TestIncarnationEpochs:
         assert time.perf_counter() - t0 < 5.0   # no real sleeping
 
 
+class TestQuorumAndReapPayloads:
+    """ISSUE 15 satellites: the survivor-consensus quorum barrier and
+    the reap sweep's final-payload return — both zero-sleep."""
+
+    def _mgr(self, tmp_path, now, sleeps=None, **kw):
+        def fake_sleep(s):
+            if sleeps is not None:
+                sleeps.append(s)
+            now[0] += s
+
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=30,
+                             clock=lambda: now[0])
+        kw.setdefault("min_nodes", 1)
+        kw.setdefault("max_nodes", 8)
+        return st, ElasticManager(st, stabilize_s=kw.pop("stabilize_s", 1.0),
+                                  clock=lambda: now[0], sleep=fake_sleep,
+                                  **kw)
+
+    def test_wait_for_quorum_zero_sleep(self, tmp_path):
+        now = [0.0]
+        sleeps = []
+        st, mgr = self._mgr(tmp_path, now, sleeps)
+        # below quorum: polls to the deadline, returns None, no real wall
+        t0 = time.perf_counter()
+        st.register("a")
+        assert mgr.wait_for_quorum(3, deadline_s=30.0) is None
+        assert now[0] >= 30.0 and sleeps.count(0.2) > 100
+        # at/above quorum: returns the rank-ordered surviving world after
+        # one stabilize window — quorum is a FLOOR, not an exact size
+        st.register("a")   # its lease lapsed during the faked 30s wait
+        st.register("b")
+        st.register("c")
+        st.register("d")
+        assert mgr.wait_for_quorum(3, deadline_s=30.0) \
+            == ["a", "b", "c", "d"]
+        assert 1.0 in sleeps  # the stabilize window ran, faked
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_wait_for_quorum_even_with_zero_deadline(self, tmp_path):
+        now = [0.0]
+        st, mgr = self._mgr(tmp_path, now, stabilize_s=0.0)
+        st.register("a")
+        # membership is checked at least once before the deadline verdict
+        assert mgr.wait_for_quorum(1, deadline_s=0.0) == ["a"]
+        with pytest.raises(ValueError):
+            mgr.wait_for_quorum(0)
+
+    def test_reap_stale_returns_final_payloads(self, tmp_path):
+        now = [0.0]
+        st, mgr = self._mgr(tmp_path, now)
+        st.register("a")
+        st.register("b", payload={"step": 1, "loss": 0.5})
+        st.heartbeat("b", payload={"step": 7, "loss": 0.25})
+        now[0] += 100.0
+        reaped, payloads = mgr.reap_stale(timeout_s=50,
+                                          return_payloads=True)
+        assert reaped == ["a", "b"]
+        # the LAST delivered payload rides out with the reap; a pod that
+        # never reported one yields None (not a KeyError)
+        assert payloads["b"] == {"step": 7, "loss": 0.25}
+        assert payloads["a"] is None
+        # the legacy ids-only return shape is unchanged
+        assert mgr.reap_stale(timeout_s=50) == []
+
+    def test_noop_sweep_does_not_rewrite_the_store(self, tmp_path):
+        """Review regression: reap/alive sweeps run every supervised
+        train step (and every router tick); a sweep that deletes
+        nothing must not re-serialize + os.replace the store file —
+        the inode only changes on a real mutation."""
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=1000)
+        st.register("a")
+        ino = os.stat(tmp_path / "m.json").st_ino
+        assert st.reap_stale(1000) == []          # no-op sweep
+        assert sorted(st.alive()) == ["a"]        # no-op expiry
+        assert os.stat(tmp_path / "m.json").st_ino == ino
+        st.heartbeat("a")                         # real mutation rewrites
+        assert os.stat(tmp_path / "m.json").st_ino != ino
+
+    def test_store_injectable_clock_drives_expiry(self, tmp_path):
+        now = [0.0]
+        st = MembershipStore(str(tmp_path / "m.json"), ttl=10,
+                             clock=lambda: now[0])
+        st.register("a")
+        now[0] = 5.0
+        st.heartbeat("a")
+        now[0] = 14.0          # 9s since the renewed beat: still live
+        assert sorted(st.alive()) == ["a"]
+        now[0] = 26.0          # lease lapsed on the fake clock alone
+        assert st.alive() == {}
+
+
 _ELASTIC_WORKER = '''
 import os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")

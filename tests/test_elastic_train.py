@@ -1,0 +1,428 @@
+"""Elastic multichip training (`paddle_tpu/resilience/elastic_train.py`).
+
+Every failure path drives through the deterministic fault registry or an
+injected clock/wait — zero real sleeps outside the jit compiles
+themselves. Covers: watchdog `on_trip` escalation (typed
+`CollectiveStalled` instead of dump-and-hang), the detection funnel
+(collective abort / watchdog stall / reap-by-silence) into one typed
+`WorldChanged`, epoch fencing (stale-incarnation writes rejected),
+quorum re-formation, reshard-on-resume with token-for-token post-resume
+loss parity, StepGuard composition (NaN rollback is NOT a reform),
+reform budget, recovery gauges + flight dump + profiler section, and
+the heartbeat ticker. The full-size 8->7 scenario is
+`tools/train_chaos_smoke.py` (slow-marked here)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.communication.watchdog import (CollectiveStalled,
+                                                           CommWatchdog)
+from paddle_tpu.distributed.elastic import ElasticManager, MembershipStore
+from paddle_tpu.framework import monitor
+from paddle_tpu.resilience import (CheckpointManager, CollectiveAborted,
+                                   ElasticTrainSupervisor, QuorumLost,
+                                   ReformBudgetExceeded,
+                                   make_emulated_trainable, faults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def make_supervisor(tmp_path, n=4, min_world=2, clock=None, ttl=1000.0,
+                    build=None, **kw):
+    pods = [f"pod{i}" for i in range(n)]
+    store_kw = {"ttl": ttl}
+    if clock is not None:
+        store_kw["clock"] = clock
+        kw.setdefault("clock", clock)
+    store = MembershipStore(str(tmp_path / "members.json"), **store_kw)
+    mgr_kw = dict(stabilize_s=0.0, sleep=lambda s: None)
+    if clock is not None:
+        mgr_kw["clock"] = clock
+    mgr = ElasticManager(store, min_nodes=1, max_nodes=n, **mgr_kw)
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), keep_last_n=64,
+                             sleep=lambda s: None)
+    kw.setdefault("quorum_deadline_s", 5.0)
+    sup = ElasticTrainSupervisor(build or make_emulated_trainable(),
+                                 mgr, ckpt, pods, min_world=min_world,
+                                 save_every=1, **kw)
+    return sup, store, mgr, ckpt
+
+
+def reference_from_restored(sup, ckpt, steps):
+    """Unkilled reference at the surviving world, restored from the same
+    checkpoint the supervisor resharded from: {step: loss}."""
+    tr = make_emulated_trainable()(sup.world)
+    ckpt.load(os.path.join(ckpt.root, f"step_{sup.last_restored_step:06d}"),
+              state_dict=tr.state_dict(), placements=tr.placements())
+    return {i: tr.step(i) for i in range(sup.last_restored_step + 1, steps)}
+
+
+# ---------------------------------------------------------------------------
+# CommWatchdog escalation (satellite)
+# ---------------------------------------------------------------------------
+class TestWatchdogEscalation:
+    def test_handled_trip_suppresses_kill_and_carries_meta(self):
+        """A trip whose escalation hook reports HANDLED must NOT
+        os._exit: the hook receives a typed CollectiveStalled naming
+        op/meta/elapsed, and diagnostics (counter) still happen first."""
+        got = []
+        now = [100.0]
+        trips0 = monitor.get("comm.watchdog_trips")
+
+        def handle(exc):
+            got.append(exc)
+            return True   # the supervisor can re-form in-process
+
+        wd = CommWatchdog("all_reduce", timeout=5.0, action="kill",
+                          meta={"bytes": 64, "step": 3},
+                          clock=lambda: now[0],
+                          wait=lambda _t: False,
+                          on_trip=handle)
+        wd.started_at = now[0]
+        now[0] += 9.0
+        wd._watch()  # synchronous: would have os._exit(124) unhandled
+        assert wd.tripped
+        assert monitor.get("comm.watchdog_trips") == trips0 + 1
+        (exc,) = got
+        assert isinstance(exc, CollectiveStalled)
+        assert exc.op_name == "all_reduce"
+        assert exc.meta["bytes"] == 64 and exc.meta["step"] == 3
+        assert exc.elapsed_s == 9.0
+
+    def test_unhandled_trip_falls_through_to_action(self, capsys):
+        """A hook that cannot unwedge the blocked caller (returns
+        falsy) must not disarm the watchdog's last resort: the
+        configured action still runs after the escalation."""
+        got = []
+        wd = CommWatchdog("all_reduce", timeout=5.0, action="log",
+                          wait=lambda _t: False, on_trip=got.append)
+        wd.started_at = 0.0
+        wd._watch()   # action="log": the fall-through is observable
+        assert got and wd.tripped
+        assert "stuck" in capsys.readouterr().err
+
+    def test_on_trip_exception_propagates_on_synchronous_drive(self):
+        def boom(exc):
+            raise exc
+
+        wd = CommWatchdog("barrier", timeout=1.0, action="log",
+                          wait=lambda _t: False, on_trip=boom)
+        wd.started_at = 0.0
+        with pytest.raises(CollectiveStalled):
+            wd._watch()
+
+    def test_raising_hook_never_disarms_the_kill(self, monkeypatch):
+        """Review regression: a broken user hook that raises on the
+        watchdog thread must count as UNHANDLED — the exit-124 last
+        resort still fires, instead of the exception killing the thread
+        and wedging the job."""
+        import paddle_tpu.distributed.communication.watchdog as wdm
+
+        exits = []
+        monkeypatch.setattr(wdm.os, "_exit",
+                            lambda code: exits.append(code))
+
+        def broken(exc):
+            raise RuntimeError("bug in the hook")
+
+        wd = CommWatchdog("all_reduce", timeout=1.0, action="kill",
+                          wait=lambda _t: False, on_trip=broken)
+        wd.started_at = 0.0
+        # the patched _exit returns (the real one never does), so the
+        # hook's exception re-surfaces afterwards — what matters is that
+        # the kill was reached FIRST
+        with pytest.raises(RuntimeError, match="bug in the hook"):
+            wd._watch()
+        assert exits == [124]
+
+    def test_no_trip_no_escalation(self):
+        got = []
+        wd = CommWatchdog("barrier", timeout=1.0, action="log",
+                          wait=lambda _t: True, on_trip=got.append)
+        wd.started_at = 0.0
+        wd._watch()
+        assert not got and not wd.tripped
+
+
+# ---------------------------------------------------------------------------
+# supervisor: detection funnel -> reform -> reshard -> resume
+# ---------------------------------------------------------------------------
+class TestSupervisorReform:
+    def test_happy_path_trains_beats_and_checkpoints(self, tmp_path):
+        sup, store, _mgr, ckpt = make_supervisor(tmp_path, n=3)
+        with sup:
+            losses = sup.run(4)
+        assert sorted(losses) == [0, 1, 2, 3]
+        assert all(np.isfinite(v) for v in losses.values())
+        assert sup.reforms == 0 and len(sup.world) == 3
+        alive = store.alive()
+        assert sorted(alive) == [f"pod{i}" for i in range(3)]
+        # per-step payload heartbeats: final step/loss on every lease
+        for ent in alive.values():
+            assert ent["payload"]["step"] == 3
+            assert ent["payload"]["loss"] == losses[3]
+        assert ckpt.latest_valid()[0] == 3
+
+    def test_chaos_kill_reforms_fences_and_resumes_bitwise(self, tmp_path):
+        from paddle_tpu.observability import timeline
+
+        timeline.configure(flight_dir=str(tmp_path / "flight"))
+        reforms0 = monitor.get("elastic.reforms")
+        sup, store, _mgr, ckpt = make_supervisor(tmp_path, n=4)
+        sup.start()
+        pre_incs = dict(sup._incarnations)
+        faults.inject("train.step", after_n=3, times=1, action="flag")
+        losses = sup.run(8)
+        sup.close()
+        # the busiest pod (tie -> highest id) died; world re-formed 4->3
+        assert sup.reforms == 1 and len(sup.world) == 3
+        assert "pod3" not in sup.world
+        assert sup.last_restored_step == 2
+        assert len(losses) == 8
+        assert monitor.get("elastic.reforms") - reforms0 == 1
+        # epoch fence: pre-reform incarnations can no longer write
+        assert store.heartbeat("pod0",
+                               incarnation=pre_incs["pod0"]) is False
+        assert "pod3" not in store.alive()
+        # recovery gauge published after the first post-resume step
+        assert sup.last_recovery_ms is not None
+        assert monitor.get("elastic.recovery_ms") == sup.last_recovery_ms
+        # token-for-token parity vs the unkilled world-3 reference
+        ref = reference_from_restored(sup, ckpt, 8)
+        assert {i: repr(losses[i]) for i in ref} \
+            == {i: repr(v) for i, v in ref.items()}
+        # reform forensics name the lost pod's final payload
+        dumps = [f for f in os.listdir(tmp_path / "flight")
+                 if f.startswith("flight_elastic_reform")]
+        assert dumps
+        with open(tmp_path / "flight" / dumps[0]) as f:
+            header = json.loads(f.readline())
+            first = json.loads(f.readline())
+        assert header["lost_pods"] == ["pod3"]
+        assert header["old_world"] != header["new_world"]
+        assert first["final_payload"]["step"] == 2
+        # profiler section renders
+        from paddle_tpu import profiler
+
+        text = profiler.Profiler._elastic_summary_lines()
+        assert any("Elastic:" in line for line in text)
+
+    def test_raised_collective_error_names_the_lost_pod(self, tmp_path):
+        sup, _store, _mgr, ckpt = make_supervisor(tmp_path, n=4)
+        sup.start()
+        faults.inject("train.step", after_n=2, times=1, action="raise",
+                      exc=CollectiveAborted("pod1", "NCCL abort analog"))
+        losses = sup.run(5)
+        sup.close()
+        assert sup.reforms == 1
+        assert "pod1" not in sup.world and len(sup.world) == 3
+        ref = reference_from_restored(sup, ckpt, 5)
+        for i, v in ref.items():
+            assert repr(losses[i]) == repr(v)
+
+    def test_watchdog_stall_escalates_to_reform(self, tmp_path):
+        # one watchdog wait per dispatched step: the 4th dispatch "hangs"
+        # (wait times out), every other one finishes in time
+        waits = {"n": 0}
+
+        def wait(_timeout):
+            waits["n"] += 1
+            return waits["n"] != 4
+
+        # stall_action="log": the injected wait trips while the (fast)
+        # dispatch is still in flight — unhandled — and the test process
+        # must survive the fall-through; a real deployment keeps the
+        # default ("kill" -> exit 124 -> launcher relaunch) for the
+        # truly-wedged case
+        sup, _store, _mgr, _ckpt = make_supervisor(
+            tmp_path, n=4, step_timeout_s=60.0, watchdog_wait=wait,
+            stall_action="log")
+        sup.start()
+        losses = sup.run(6)
+        sup.close()
+        # the stall was attributed to the straggler (busiest; tie ->
+        # highest id) and the mesh re-formed without it
+        assert sup.reforms == 1 and len(sup.world) == 3
+        assert "pod3" not in sup.world
+        assert len(losses) == 6
+
+    def test_reap_by_silence_zero_sleep(self, tmp_path):
+        now = [0.0]
+        base = make_emulated_trainable()
+
+        def build(world):
+            tr = base(world)
+            orig = tr.step
+
+            def step(i):
+                now[0] += 3.0  # wall time passes while the step runs
+                return orig(i)
+
+            tr.step = step
+            return tr
+
+        sup, store, _mgr, ckpt = make_supervisor(
+            tmp_path, n=4, clock=lambda: now[0], ttl=5.0, build=build,
+            reap_timeout_s=5.0)
+        sup.start()
+        # pod3's heartbeats silently stop reaching the store (host gone
+        # without a collective abort): two missed beats outlive the 5s
+        # lease at 3s/step, and the reap sweep must declare it
+        faults.inject("elastic.beat", after_n=2, times=2, action="flag")
+        losses = sup.run(7)
+        sup.close()
+        assert sup.reforms == 1
+        assert "pod3" not in sup.world and len(sup.world) == 3
+        assert len(losses) == 7
+        # the reap carried the victim's FINAL payload into the funnel
+        ref = reference_from_restored(sup, ckpt, 7)
+        for i, v in ref.items():
+            assert repr(losses[i]) == repr(v)
+
+    def test_quorum_lost_is_typed(self, tmp_path):
+        sup, _store, _mgr, _ckpt = make_supervisor(tmp_path, n=3,
+                                                   min_world=3,
+                                                   quorum_deadline_s=0.0)
+        sup.start()
+        faults.inject("train.step", after_n=1, times=1, action="flag")
+        with pytest.raises(QuorumLost):
+            sup.run(5)
+        sup.close()
+
+    def test_reform_budget_exceeded_is_typed(self, tmp_path):
+        sup, _store, _mgr, _ckpt = make_supervisor(tmp_path, n=4,
+                                                   reform_budget=1)
+        sup.start()
+        faults.inject("train.step", after_n=2, times=2, action="flag")
+        with pytest.raises(ReformBudgetExceeded):
+            sup.run(8)
+        sup.close()
+
+    def test_reform_fault_site_surfaces(self, tmp_path):
+        sup, _store, _mgr, _ckpt = make_supervisor(tmp_path, n=4)
+        sup.start()
+        faults.inject("train.step", after_n=1, times=1, action="flag")
+        faults.inject("elastic.reform", times=1)
+        with pytest.raises(faults.InjectedIOError):
+            sup.run(5)
+        sup.close()
+
+    def test_nan_rollback_is_guard_business_not_a_reform(self, tmp_path):
+        rollbacks0 = monitor.get("resilience.rollbacks")
+        sup, _store, _mgr, _ckpt = make_supervisor(tmp_path, n=3)
+        sup.start()
+        faults.inject("guard.nan_loss", after_n=3, times=1, action="flag")
+        losses = sup.run(6)
+        sup.close()
+        assert sup.reforms == 0 and len(sup.world) == 3
+        assert monitor.get("resilience.rollbacks") - rollbacks0 == 1
+        # the replayed trajectory equals a clean run's, token for token
+        clean_sup, _s2, _m2, _c2 = make_supervisor(tmp_path / "clean", n=3)
+        with clean_sup:
+            clean = clean_sup.run(6)
+        assert {i: repr(v) for i, v in losses.items()} \
+            == {i: repr(v) for i, v in clean.items()}
+
+
+    def test_restart_resets_per_run_failure_state(self, tmp_path):
+        """Review regression: close() + start() is a NEW run — a pod
+        silenced by a previous run's `elastic.beat` fault must beat
+        again (no spurious reap/reform), and the returned trajectory
+        must not drag the previous run's entries along."""
+        sup, store, _mgr, _ckpt = make_supervisor(tmp_path, n=3)
+        sup.start()
+        faults.inject("elastic.beat", times=1, action="flag")
+        sup.run(2)
+        assert sup._silenced == {"pod2"}
+        sup.close()
+        faults.clear()
+        sup.start()
+        losses = sup.run(5)   # resumes at step 2 from the checkpoint
+        sup.close()
+        assert sup.reforms == 0
+        assert sorted(losses) == [2, 3, 4]  # previous run's 0/1 not kept
+        # the previously-silenced pod heartbeats again
+        assert store.alive()["pod2"]["payload"]["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# heartbeat ticker
+# ---------------------------------------------------------------------------
+class TestHeartbeatTicker:
+    def test_tick_beat_renews_leases_with_last_payloads(self, tmp_path):
+        now = [0.0]
+        sup, store, _mgr, _ckpt = make_supervisor(tmp_path, n=3,
+                                                  clock=lambda: now[0],
+                                                  ttl=10.0)
+        sup.start()
+        sup.run(2)
+        now[0] += 8.0  # a long compile: leases nearly stale
+        sup._tick_beat()  # what the ticker thread runs between steps
+        alive = store.alive()
+        assert sorted(alive) == [f"pod{i}" for i in range(3)]
+        for ent in alive.values():
+            assert ent["last_heartbeat"] == 8.0
+            assert ent["payload"]["step"] == 1  # last real payload kept
+        sup.close()
+
+    def test_ticker_does_not_revive_a_silenced_pod(self, tmp_path):
+        """Review regression: `elastic.beat` silence is a state, not one
+        missed write — the ticker renewing the victim's lease between
+        steps would make the reap-detection path untestable under a
+        ticker (and un-detectable in production)."""
+        now = [0.0]
+        sup, store, _mgr, _ckpt = make_supervisor(tmp_path, n=3,
+                                                  clock=lambda: now[0],
+                                                  ttl=10.0)
+        sup.start()
+        faults.inject("elastic.beat", times=1, action="flag")
+        sup.run(1)       # pod2 (busiest tie -> highest id) went silent
+        t_before = store.alive()["pod2"]["last_heartbeat"]
+        now[0] += 4.0
+        sup._tick_beat()  # what the ticker runs between steps
+        alive = store.alive()
+        assert alive["pod0"]["last_heartbeat"] == 4.0  # renewed
+        assert alive["pod2"]["last_heartbeat"] == t_before  # NOT renewed
+        sup.close()
+
+    def test_ticker_thread_lifecycle(self, tmp_path):
+        ticks = []
+
+        def wait(interval):
+            ticks.append(interval)
+            return len(ticks) >= 3  # two ticks, then stop
+
+        sup, _store, _mgr, _ckpt = make_supervisor(
+            tmp_path, n=2, heartbeat_interval_s=0.01, ticker_wait=wait)
+        sup.start()
+        t = sup._ticker
+        assert t is not None
+        t.join(timeout=5.0)
+        assert not t.is_alive() and len(ticks) == 3
+        sup.close()
+        assert sup._ticker is None
+
+
+# ---------------------------------------------------------------------------
+# full-size chaos scenario (subprocess; slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_train_chaos_smoke_end_to_end():
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "train_chaos_smoke.py")
+    r = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] and out["reforms"] == 1 and out["quarantined"] == 0
+    assert out["world"] == "8->7"
+    assert out["world8_to_world4_restore"] == "bitwise"

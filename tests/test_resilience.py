@@ -706,6 +706,115 @@ class TestReviewRegressions:
 
 
 # ---------------------------------------------------------------------------
+# world-shape-changing restore (ISSUE 15 satellite): a sharded train
+# state saved at emulated world 8 restores at world 4 and 2
+# ---------------------------------------------------------------------------
+class TestWorldShapeRestore:
+    def _trained_world8(self, tmp_path, steps=3):
+        from paddle_tpu.resilience import make_emulated_trainable
+
+        tr8 = make_emulated_trainable(seed=5)([f"p{i}" for i in range(8)])
+        for i in range(steps):
+            tr8.step(i)
+        cm = make_manager(tmp_path, keep_last_n=8)
+        cm.save(steps - 1, state_dict=tr8.state_dict())
+        return tr8, cm, get_rng_state()
+
+    @pytest.mark.parametrize("world", [4, 2])
+    def test_restore_at_smaller_world_bitwise_params(self, tmp_path, world):
+        """Params + optimizer moments round-trip 8 -> world with bitwise
+        equality after gather, and the destination genuinely re-slices
+        (shard count == world, not 8)."""
+        from paddle_tpu.resilience import make_emulated_trainable
+
+        tr8, cm, rng_at_save = self._trained_world8(tmp_path)
+        trn = make_emulated_trainable(seed=99)([f"p{i}" for i in range(world)])
+        paddle.seed(12345)  # scramble the RNG between save and restore
+        assert get_rng_state() != rng_at_save
+        res = cm.restore_latest(state_dict=trn.state_dict(),
+                                placements=trn.placements())
+        assert res.step == 2
+        full8, fulln = tr8.gather(), trn.gather()
+        for k in full8:  # params AND momentum state, bitwise
+            np.testing.assert_array_equal(full8[k], fulln[k])
+        w = trn.state_dict()["w"]._data
+        assert len(w.sharding.device_set) == world
+        shard_rows = {tuple(s.data.shape) for s in w.addressable_shards}
+        assert shard_rows == {(8 // world, 8)}
+        # RNG state travels with the checkpoint (saved world's RNG wins)
+        assert get_rng_state() == rng_at_save
+
+    def test_post_resume_losses_agree_across_worlds(self, tmp_path):
+        """The restored state is the SAME math at any world size: replayed
+        steps at world 4 and world 2 agree to float tolerance (different
+        all-reduce orders), and each world replays ITSELF bitwise."""
+        from paddle_tpu.resilience import make_emulated_trainable
+
+        _tr8, cm, _rng = self._trained_world8(tmp_path)
+        out = {}
+        for world in (4, 2):
+            losses = {}
+            tr = make_emulated_trainable()([f"p{i}" for i in range(world)])
+            cm.restore_latest(state_dict=tr.state_dict(),
+                              placements=tr.placements())
+            for i in range(3, 6):
+                losses[i] = tr.step(i)
+            out[world] = losses
+            # bitwise self-replay at the same world size
+            tr2 = make_emulated_trainable()([f"p{i}" for i in range(world)])
+            cm.restore_latest(state_dict=tr2.state_dict(),
+                              placements=tr2.placements())
+            for i in range(3, 6):
+                assert repr(tr2.step(i)) == repr(losses[i])
+        for i in range(3, 6):
+            np.testing.assert_allclose(out[4][i], out[2][i], rtol=1e-5)
+
+    def test_placements_unknown_key_raises(self, tmp_path):
+        cm = make_manager(tmp_path)
+        st = small_state()
+        cm.save(0, state_dict=st)
+        with pytest.raises(KeyError, match="typo"):
+            cm.restore_latest(state_dict=small_state(),
+                              placements={"typo": None})
+
+
+# ---------------------------------------------------------------------------
+# StepGuard functional-state path + escalation passthrough (ISSUE 15)
+# ---------------------------------------------------------------------------
+class TestStepGuardElasticHooks:
+    def test_state_dict_rollback_restores_bitwise(self, tmp_path):
+        cm = make_manager(tmp_path)
+        st = small_state(seed=4)
+        snap = {k: np.asarray(t._data).copy() for k, t in st.items()}
+        losses = iter([1.0, float("nan")])
+        guard = StepGuard(lambda i: next(losses), cm, state_dict=st,
+                          save_every=1)
+        assert guard.step(0) == 1.0          # periodic save flows the dict
+        for k, t in st.items():              # doctor the live state
+            t._data = t._data * 0 + 7.0
+        assert guard.step(1) is None         # NaN -> rollback via the dict
+        for k, t in st.items():
+            np.testing.assert_array_equal(np.asarray(t._data), snap[k])
+
+    def test_escalate_types_pass_through_untripped(self, tmp_path):
+        from paddle_tpu.resilience import CollectiveAborted
+
+        cm = make_manager(tmp_path)
+        cm.save(0, state_dict=small_state())
+        trips0 = monitor.get("resilience.trips.exception")
+
+        def step_fn(i):
+            raise CollectiveAborted("pod2")
+
+        guard = StepGuard(step_fn, cm, state_dict=small_state(),
+                          escalate=(CollectiveAborted,))
+        with pytest.raises(CollectiveAborted):
+            guard.step(1)
+        # NOT a trip: no rollback, no counter — the supervisor owns it
+        assert monitor.get("resilience.trips.exception") == trips0
+
+
+# ---------------------------------------------------------------------------
 # crash-kill/resume integration (subprocess driver; slow)
 # ---------------------------------------------------------------------------
 @pytest.mark.slow

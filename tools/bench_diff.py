@@ -62,6 +62,7 @@ _METRIC_TO_SCENARIO = {
     "dryrun_multichip_comms": "dryrun_multichip",
     "serving_fleet_tok_s": "serving_fleet",
     "serving_shared_prefix_tok_s": "serving_shared_prefix",
+    "train_elastic_recovery_ms": "train_elastic",
 }
 
 

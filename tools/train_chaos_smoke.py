@@ -1,0 +1,186 @@
+"""Elastic-training chaos smoke: kill a pod mid-step, prove the loop.
+
+Single process on the 8-virtual-device CPU mesh (the `dryrun_multichip`
+substrate — `crash_resume_smoke.py` conventions: deterministic fault
+injection, token-for-token loss comparison, one JSON summary line,
+<20 s CPU). The scenario:
+
+1. an `ElasticTrainSupervisor` trains a world-8 sharded step
+   (parameters + momentum sharded over the ``world`` axis, per-step
+   heartbeats with step/loss payloads, checkpoint every step);
+2. an armed ``train.step`` fault kills the **busiest** emulated pod
+   mid-step at step KILL_AT — its collective aborts;
+3. the supervisor fences the dead epoch (survivor incarnations bump; a
+   heartbeat carrying the old incarnation is REJECTED — asserted),
+   agrees on the surviving world under quorum, re-forms 8 -> 7,
+   reshards the latest checkpoint onto the new mesh, and resumes.
+
+Asserts, all in-run:
+- post-resume losses are **token-for-token** (`repr`) equal to an
+  unkilled world-7 reference run restored from the same checkpoint;
+- reforms <= budget (exactly 1), ``elastic.recovery_ms`` gauge
+  published, ``elastic.reforms``/``elastic.lost_pods`` counters bumped;
+- the ``flight_elastic_reform_*.jsonl`` forensics dump names the lost
+  pod with its final heartbeat payload (step/loss);
+- zero quarantined-dir leaks (the kill was an emulated host loss, not
+  a torn save — recovery must not quarantine anything);
+- the same world-8 checkpoint restores at world 4 with **bitwise**
+  equal gathered parameters (the reshard-on-load contract);
+- the "Elastic:" profiler section renders.
+
+Usage: python tools/train_chaos_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_PODS = 8
+STEPS = 14
+KILL_AT = 7
+REFORM_BUDGET = 2
+
+
+def _force_cpu(n: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def main() -> int:
+    t0 = time.time()
+    _force_cpu(N_PODS)
+    sys.path.insert(0, REPO)
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.elastic import ElasticManager, MembershipStore
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.observability import timeline
+    from paddle_tpu.resilience import (CheckpointManager,
+                                       ElasticTrainSupervisor,
+                                       make_emulated_trainable, faults)
+
+    work = tempfile.mkdtemp(prefix="train_chaos_smoke_")
+    timeline.configure(flight_dir=os.path.join(work, "flight"))
+    pods = [f"pod{i}" for i in range(N_PODS)]
+    store = MembershipStore(os.path.join(work, "members.json"), ttl=1000.0)
+    mgr = ElasticManager(store, min_nodes=1, max_nodes=N_PODS,
+                         stabilize_s=0.0, sleep=lambda s: None)
+    ckpt = CheckpointManager(os.path.join(work, "ckpt"),
+                             keep_last_n=STEPS + 1)
+    reforms0 = monitor.get("elastic.reforms")
+    lost0 = monitor.get("elastic.lost_pods")
+    stale0 = monitor.get("elastic.stale_heartbeats")
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    sup = ElasticTrainSupervisor(
+        make_emulated_trainable(), mgr, ckpt, pods, min_world=2,
+        save_every=1, reform_budget=REFORM_BUDGET, quorum_deadline_s=5.0)
+    sup.start()
+    pre_kill_incs = dict(sup._incarnations)
+    # the busiest pod (highest last step wall, ties -> highest id) dies
+    # mid-step: its collective aborts, the in-flight step is discarded
+    faults.inject("train.step", after_n=KILL_AT, times=1, action="flag")
+    losses = sup.run(STEPS)
+    sup.close()
+    faults.clear()
+    prof.stop()
+
+    # -- reform happened, within budget, world shrank by the victim -------
+    assert sup.reforms == 1 <= REFORM_BUDGET, sup.reforms
+    assert len(sup.world) == N_PODS - 1, sup.world
+    victim = (set(pods) - set(sup.world)).pop()
+    restored = sup.last_restored_step
+    assert restored == KILL_AT - 1, (restored, KILL_AT)
+    assert len(losses) == STEPS
+    assert monitor.get("elastic.reforms") - reforms0 == 1
+    assert monitor.get("elastic.lost_pods") - lost0 == 1
+
+    # -- recovery gauge published ----------------------------------------
+    recovery_ms = monitor.get("elastic.recovery_ms")
+    assert recovery_ms and recovery_ms == sup.last_recovery_ms, recovery_ms
+
+    # -- epoch fencing: the dead epoch's incarnation cannot write --------
+    assert store.heartbeat("pod0", incarnation=pre_kill_incs["pod0"]) \
+        is False, "stale-incarnation heartbeat must be rejected"
+    assert monitor.get("elastic.stale_heartbeats") > stale0
+    assert victim not in store.alive()
+
+    # -- token-for-token parity vs an unkilled world-7 run ----------------
+    ref_tr = make_emulated_trainable()(sup.world)
+    ckpt.load(os.path.join(ckpt.root, f"step_{restored:06d}"),
+              state_dict=ref_tr.state_dict(),
+              placements=ref_tr.placements())
+    mismatches = {}
+    for i in range(restored + 1, STEPS):
+        ref = ref_tr.step(i)
+        if repr(ref) != repr(losses[i]):
+            mismatches[i] = (repr(losses[i]), repr(ref))
+    assert not mismatches, f"post-resume trajectory diverged: {mismatches}"
+
+    # -- forensics: flight dump names the lost pod's final step/loss ------
+    dumps = [f for f in os.listdir(os.path.join(work, "flight"))
+             if f.startswith("flight_elastic_reform")]
+    assert dumps, "no elastic reform flight dump"
+    with open(os.path.join(work, "flight", dumps[0])) as f:
+        header = json.loads(f.readline())
+        first = json.loads(f.readline())
+    assert header["lost_pods"] == [victim], header
+    assert header["restored_step"] == restored
+    assert first["lost_pod"] == victim
+    assert first["final_payload"]["step"] == restored, first
+    assert "loss" in first["final_payload"]
+
+    # -- zero quarantined-dir leaks --------------------------------------
+    quarantined = [d for d in os.listdir(ckpt.root)
+                   if d.startswith("QUARANTINED-")]
+    assert not quarantined, quarantined
+
+    # -- reshard-on-load: the world-8 checkpoint restores at world 4 ------
+    # with bitwise-equal gathered parameters (a genuine re-slice: the
+    # same bytes, 4 shards instead of 8)
+    tr8 = make_emulated_trainable()(pods)
+    ckpt8 = CheckpointManager(os.path.join(work, "ckpt8"))
+    for i in range(3):
+        tr8.step(i)
+    ckpt8.save(2, state_dict=tr8.state_dict())
+    tr4 = make_emulated_trainable(seed=123)(pods[:4])
+    res = ckpt8.restore_latest(state_dict=tr4.state_dict(),
+                               placements=tr4.placements())
+    assert res.step == 2
+    full8, full4 = tr8.gather(), tr4.gather()
+    for k in full8:
+        np.testing.assert_array_equal(full8[k], full4[k])
+    w4 = tr4.state_dict()["w"]._data
+    assert len(w4.sharding.device_set) == 4
+
+    # -- profiler section -------------------------------------------------
+    text = prof.summary()
+    assert "Elastic:" in text and "mesh re-formations" in text
+
+    print(json.dumps({
+        "ok": True, "steps": STEPS, "killed_at": KILL_AT,
+        "victim": victim, "world": f"{N_PODS}->{len(sup.world)}",
+        "restored_from": restored,
+        "replayed_steps_bitwise_equal": STEPS - restored - 1,
+        "recovery_ms": recovery_ms, "reforms": sup.reforms,
+        "quarantined": 0,
+        "world8_to_world4_restore": "bitwise",
+        "secs": round(time.time() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
