@@ -13,8 +13,17 @@ compiled artifact against `hlo_manifest.json`:
   (device-side gather/sampling, exact-dtype numpy into the C++ dispatch
   path) exists so no per-token host round-trip survives compilation.
 - ``collective_ops_max`` — total collective instructions
-  (`hlo_comm_census`, PR 8). Single-chip programs budget zero; a
-  TP-sharded step (ROADMAP item 2) will budget its exact census.
+  (`hlo_comm_census`, PR 8). Single-chip programs budget zero; the
+  TP-sharded serving step (`ragged_decode_tp`, ISSUE 16) budgets its
+  exact census.
+- ``collective_budget`` — per-KIND op ceilings for sharded programs
+  (``{"all_reduce": 8, "all_gather": 1}``, census kind names). Kinds
+  the census finds but the budget does not name are findings: a
+  resharding change must re-budget its comm profile deliberately, not
+  smuggle a new collective kind under the total.
+- ``collective_bytes_max`` — cap on the census' total per-step comm
+  bytes; the T3 tiling keeps ops high but bytes flat, and this is the
+  key that catches a decomposition silently inflating payloads.
 - ``declared_dtype`` — ``"bf16"`` forbids f32 ``dot``/``convolution``
   results (a silent upcast doubles gemm bytes and halves MXU rate);
   f32 programs declare ``"f32"`` and skip the check.
@@ -47,6 +56,7 @@ DEFAULT_MANIFEST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "hlo_manifest.json")
 
 _KNOWN_KEYS = {"host_transfer_ops_max", "collective_ops_max",
+               "collective_bytes_max", "collective_budget",
                "declared_dtype", "op_budget", "note"}
 
 
@@ -164,6 +174,28 @@ def audit_text(hlo_text: str, entry: dict,
             f"(census: { {k: v['ops'] for k, v in census.items()} }) — "
             "the program's comm profile changed; re-budget the manifest "
             "deliberately if the sharding change is intentional")
+    kind_budget = entry.get("collective_budget")
+    if kind_budget is not None:
+        for kind, e in sorted(census.items()):
+            cap = kind_budget.get(kind)
+            if cap is None:
+                findings.append(
+                    f"collective_budget: unbudgeted collective kind "
+                    f"{kind!r} x{e['ops']} — a new collective kind "
+                    "entered the program; name it in the manifest "
+                    "deliberately")
+            elif e["ops"] > int(cap):
+                findings.append(
+                    f"collective_budget: {kind} x{e['ops']} > budget "
+                    f"{cap}")
+    bytes_max = entry.get("collective_bytes_max")
+    collective_bytes = sum(e["bytes"] for e in census.values())
+    actuals["collective_bytes"] = collective_bytes
+    if bytes_max is not None and collective_bytes > bytes_max:
+        findings.append(
+            f"collective_bytes {collective_bytes} > budget {bytes_max} "
+            "— the step's comm payload grew (tiling must keep bytes "
+            "flat while splitting ops)")
     declared = entry.get("declared_dtype")
     if declared == "bf16" and platform not in (None, "tpu"):
         actuals["declared_dtype_check"] = (
@@ -262,6 +294,55 @@ def _exe_ragged_decode_quant():
                          q_lens, kv_lens, tables)
 
 
+def _exe_ragged_decode_tp():
+    """The TP-SHARDED serving decode program (ISSUE 16): the MLP audit
+    engine through `serving.tp.shard_engine(tp=2, overlap=True)` at the
+    same packed shapes as `ragged_decode`. Its manifest entry budgets
+    the exact deliberate census — the tiled row-parallel psums
+    (all_reduce) plus ONE logit all_gather — with a byte cap (tiling
+    splits ops, never grows bytes) and ZERO host transfers: decode
+    finishes with a device-side gathered logit shard, never a host
+    assembly. Needs >= 2 devices (ptlint --hlo-audit forces an 8-device
+    CPU topology before importing jax)."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+    from ..serving.tp import shard_engine
+
+    eng = shard_engine(
+        MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                    num_blocks=16, block_size=4, max_blocks_per_seq=4),
+        tp=2, overlap=True, overlap_tiles=2)
+    B, T = 4, 4 + 8                       # max_batch + chunk budget
+    tokens = np.zeros((T,), np.int32)
+    q_lens = np.array([1, 1, 2, 0], np.int32)
+    kv_lens = np.array([3, 1, 2, 0], np.int32)
+    tables = np.zeros((B, 4), np.int32)
+    fn, lead = eng.cost_card_args("ragged")
+    return fn, (*lead, tokens, q_lens, kv_lens, tables)
+
+
+def _exe_verify_tp():
+    """The TP-sharded speculative verify program (same sharded substrate
+    as `ragged_decode_tp`, [B, K+1] window) — spec must stay as
+    device-side under TP as plain decode."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+    from ..serving.tp import shard_engine
+
+    eng = shard_engine(
+        MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                    num_blocks=16, block_size=4, max_blocks_per_seq=4),
+        tp=2, overlap=True, overlap_tiles=2)
+    B, S = 4, 3
+    tokens = np.zeros((B, S), np.int32)
+    ctx = np.full((B,), S, np.int32)
+    tables = np.zeros((B, 4), np.int32)
+    fn, lead = eng.cost_card_args("verify")
+    return fn, (*lead, tokens, ctx, tables)
+
+
 def _exe_quant_matmul():
     """The weight-only dequant gemm (`nn.quant.dequant_matmul`) at an
     aligned bf16 x int8 shape — the executable every quantized engine's
@@ -318,8 +399,10 @@ def _exe_train_step():
 EXECUTABLES = {
     "ragged_decode": _exe_ragged_decode,
     "ragged_decode_quant": _exe_ragged_decode_quant,
+    "ragged_decode_tp": _exe_ragged_decode_tp,
     "quant_matmul": _exe_quant_matmul,
     "verify": _exe_verify,
+    "verify_tp": _exe_verify_tp,
     "sampler": _exe_sampler,
     "train_step": _exe_train_step,
 }
@@ -361,12 +444,23 @@ def load_manifest(path: str) -> dict:
             raise ManifestError(
                 f"manifest {path}: executable {name!r}: unknown key(s) "
                 f"{sorted(unknown)} (known: {sorted(_KNOWN_KEYS)})")
-        for key in ("host_transfer_ops_max", "collective_ops_max"):
+        for key in ("host_transfer_ops_max", "collective_ops_max",
+                    "collective_bytes_max"):
             if key in entry and not (isinstance(entry[key], int)
                                      and not isinstance(entry[key], bool)):
                 raise ManifestError(
                     f"manifest {path}: executable {name!r}: {key} must "
                     f"be an integer, got {entry[key]!r}")
+        kind_budget = entry.get("collective_budget")
+        if kind_budget is not None and not (
+                isinstance(kind_budget, dict)
+                and all(isinstance(k, str) and isinstance(v, int)
+                        and not isinstance(v, bool)
+                        for k, v in kind_budget.items())):
+            raise ManifestError(
+                f"manifest {path}: executable {name!r}: "
+                "collective_budget must map census kind -> integer, "
+                f"got {kind_budget!r}")
         if "declared_dtype" in entry \
                 and not isinstance(entry["declared_dtype"], str):
             raise ManifestError(

@@ -55,6 +55,14 @@ HOT_PATHS = {
     # host conversions allowed
     ("serving/engine.py", "MLPLMEngine.copy_kv_block"),
     ("inference/llama_runner.py", "LlamaInferenceEngine.copy_kv_block"),
+    # the TP-sharded dispatch surfaces (ISSUE 16): every token of every
+    # multichip serving run crosses these — the shard_map program is one
+    # dispatch; stray host work here multiplies by tp chips' worth of
+    # traffic
+    ("serving/tp.py", "ShardedEngine.ragged_step"),
+    ("serving/tp.py", "ShardedEngine.verify_step"),
+    ("serving/tp.py", "ShardedEngine._dispatch"),
+    ("serving/tp.py", "ShardedEngine.copy_kv_block"),
     # the elastic supervisor's per-step heartbeat: one membership-store
     # write per train step — a per-call device_put/import/extra blocking
     # call here lands on EVERY step of every supervised training run

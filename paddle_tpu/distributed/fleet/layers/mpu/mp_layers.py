@@ -112,15 +112,25 @@ class ColumnParallelLinear(Layer):
 
 class RowParallelLinear(Layer):
     """Linear with in_features sharded over mp; output is all-reduced by
-    GSPMD (reference `mp_layers.py:541`)."""
+    GSPMD (reference `mp_layers.py:541`).
+
+    ``overlap_tiles > 1`` decomposes the gemm's output axis through
+    `distributed.tp_overlap.row_parallel_matmul` (GSPMD mode): GSPMD
+    then inserts one all-reduce per tile instead of one big one, and the
+    latency-hiding scheduler overlaps tile k's reduction with tile k+1's
+    compute — the same decomposition the TP serving engines run with
+    explicit psums (`serving/tp.py`). Numerically identical to the
+    undecomposed layer (tile concat reassembles the exact columns)."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False,
-                 fuse_matmul_bias=False, mp_group=None, name=None):
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 overlap_tiles=1):
         super().__init__()
         self._in_features = in_features
         self._out_features = out_features
         self.input_is_parallel = input_is_parallel
+        self.overlap_tiles = int(overlap_tiles)
         hcg = get_hybrid_communicate_group()
         self.world_size = hcg.get_model_parallel_world_size() if hcg else 1
         self.is_mp = self.world_size > 1
@@ -135,6 +145,15 @@ class RowParallelLinear(Layer):
             _place(self.bias, mesh, None)  # bias replicated (added post-sum)
 
     def forward(self, x):
+        if self.overlap_tiles > 1:
+            from .....ops._helpers import as_tensor
+            from ....tp_overlap import row_parallel_matmul
+
+            y = as_tensor(row_parallel_matmul(
+                x, self.weight, axis_name=None,
+                ntiles=self.overlap_tiles,
+                mm=lambda a, w: F.linear(a, w)))
+            return y + self.bias if self.bias is not None else y
         return F.linear(x, self.weight, self.bias)
 
 

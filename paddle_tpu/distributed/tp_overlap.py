@@ -1,0 +1,126 @@
+"""Tiled tensor-parallel matmul decomposition — compute/collective overlap.
+
+The T3 observation (PAPERS.md, arXiv 2401.16677): a row-parallel TP
+matmul followed by ONE big all-reduce serializes the program — every
+MXU cycle of the gemm must retire before the first ICI byte moves. The
+fix is decomposition: split the gemm's output (N) axis into tiles and
+reduce each tile as soon as it is produced. Tile k's `psum` has no data
+dependency on tile k+1's gemm, so XLA's latency-hiding scheduler turns
+each reduction into an async `all-reduce-start`/`all-reduce-done` pair
+and slides tile k+1's compute between them — the collective rides the
+ICI while the MXU keeps streaming. The HLO comm census of a decomposed
+program shows `ntiles` collectives per gemm carrying the same total
+bytes; the audit manifest budgets them deliberately
+(`analysis/hlo_audit.py`, `ragged_decode_tp`).
+
+Two consumers share the SAME decomposition:
+
+- the TP-sharded serving engines (`serving/tp.py`): explicit-collective
+  mode — the matmuls run inside `shard_map`, `axis_name` names the mesh
+  axis and each tile is `lax.psum`-reduced in-program;
+- the train step's TP layers (`fleet/layers/mpu/mp_layers.py`,
+  `RowParallelLinear(overlap_tiles=...)`): GSPMD mode — `axis_name` is
+  None, the tiling alone restructures the program, and GSPMD inserts
+  one all-reduce per tile exactly where the explicit mode put its psum.
+
+Weights may be dense `[..., K, N]` arrays or the weight-only-quantized
+`{"q"|"q4" [..., N, K(/2)], "s" [..., N]}` dicts both engines' matmul
+helpers route through `nn.quant.dequant_matmul` — tiles slice the
+output-channel axis of either layout, so quantized TP engines overlap
+exactly like full-precision ones.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+__all__ = ["TPInfo", "row_parallel_matmul", "gather_columns",
+           "out_features", "slice_out_channels"]
+
+
+class TPInfo(NamedTuple):
+    """Hashable TP execution config threaded through the engines' static
+    cfg objects (`_StaticCfg` hashes its __dict__, so this must hash).
+
+    - ``axis``: shard_map mesh axis name the collectives run over;
+    - ``size``: number of shards on that axis (tp degree);
+    - ``tiles``: row-parallel gemm decomposition factor (1 = the
+      sequential single-collective baseline);
+    - ``gather_logits``: True finishes decode with an in-program
+      all-gather of the vocab-sharded logit shard (device-side, feeds
+      the fused sampler); False returns the shard and the caller pays a
+      host-side assembly — the fully-exposed baseline the bench A/Bs.
+    """
+
+    axis: str
+    size: int
+    tiles: int
+    gather_logits: bool
+
+
+def out_features(w) -> int:
+    """Output-channel count of a dense `[..., K, N]` weight or a
+    quantized `{"q"|"q4", "s" [..., N]}` dict."""
+    if isinstance(w, dict):
+        return int(w["s"].shape[-1])
+    return int(w.shape[-1])
+
+
+def slice_out_channels(w, lo: int, hi: int):
+    """One output-channel tile of `w` (dense column slice; quantized
+    dicts slice the N axis of q/q4 and s — the K/packed axis is left
+    whole, so int4 packing never splits a byte)."""
+    if isinstance(w, dict):
+        out = {"s": w["s"][..., lo:hi]}
+        key = "q4" if "q4" in w else "q"
+        out[key] = w[key][..., lo:hi, :]
+        return out
+    return w[..., :, lo:hi]
+
+
+def _default_mm(x, w):
+    return x @ w
+
+
+def row_parallel_matmul(x, w, *, axis_name: Optional[str] = None,
+                        ntiles: int = 1,
+                        mm: Optional[Callable] = None):
+    """`x [..., K_local] @ w [..., K_local, N]` with the partial sums
+    reduced over `axis_name`, decomposed into `ntiles` output tiles so
+    tile k's reduction overlaps tile k+1's compute (module docstring).
+
+    `axis_name=None` skips the explicit psum (GSPMD mode: the caller's
+    sharding makes XLA insert the per-tile all-reduce). `ntiles` is
+    clamped to the largest divisor of N at or below the request, so an
+    awkward N degrades to fewer tiles instead of failing. `mm` is the
+    caller's matmul helper (the engines pass their quant-routing `_mm`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mm = mm or _default_mm
+    n = out_features(w)
+    tiles = max(1, min(int(ntiles), n))
+    while n % tiles:
+        tiles -= 1
+    if tiles == 1:
+        y = mm(x, w)
+        return jax.lax.psum(y, axis_name) if axis_name else y
+    step = n // tiles
+    outs = []
+    for k in range(tiles):
+        yk = mm(x, slice_out_channels(w, k * step, (k + 1) * step))
+        if axis_name:
+            yk = jax.lax.psum(yk, axis_name)
+        outs.append(yk)
+    # jnp.asarray unwraps framework Tensor results (via __jax_array__) —
+    # the mp_layers consumer's mm returns wrapped values
+    return jnp.concatenate([jnp.asarray(y) for y in outs], axis=-1)
+
+
+def gather_columns(y, axis_name: str):
+    """All-gather a column-parallel result's shards along the last axis
+    (tiled: shard s's columns land at `[s*N_local, (s+1)*N_local)` — the
+    contiguous layout the column split produced them from)."""
+    import jax
+
+    return jax.lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)

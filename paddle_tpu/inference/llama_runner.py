@@ -542,12 +542,27 @@ def _layer_body(x, layer_in, *, cfg, positions, tables, ctx_lens, mode,
                 qr, kc, vc, tables, ctx_lens, tok_lane, tok_pos,
                 k_scale=ks, v_scale=vs)
         attn = attn.reshape(1, s, nh * d).astype(x.dtype)
-        x = x + _mm(attn, o_w)
+        tp = getattr(cfg, "tp", None)
+        if tp is not None:
+            # TP-sharded ragged step (serving/tp.py): o_w/down_w are
+            # row-parallel shards, so their gemms produce partial sums
+            # reduced over the mesh axis — tiled, so tile k's psum
+            # overlaps tile k+1's compute (distributed/tp_overlap.py)
+            from ..distributed.tp_overlap import row_parallel_matmul
+
+            x = x + row_parallel_matmul(attn, o_w, axis_name=tp.axis,
+                                        ntiles=tp.tiles, mm=_mm)
+        else:
+            x = x + _mm(attn, o_w)
         h2 = _rms(x, ln2, cfg.eps)
         gu = _mm(h2, gu_w)
         g, u = jnp.split(gu, 2, axis=-1)
         act = jax.nn.silu(g.astype(jnp.float32)).astype(g.dtype) * u
-        x = x + _mm(act, down_w)
+        if tp is not None:
+            x = x + row_parallel_matmul(act, down_w, axis_name=tp.axis,
+                                        ntiles=tp.tiles, mm=_mm)
+        else:
+            x = x + _mm(act, down_w)
         if kv_scales is not None:
             return x, (kc, vc, ks, vs)
         return x, (kc, vc)
@@ -630,6 +645,14 @@ def _run_stack(params, k_cache, v_cache, x, positions, tables, ctx_lens,
         logits = _mm(x, head)
     else:
         logits = jnp.einsum("bsh,hv->bsv", x, head.astype(x.dtype))
+    tp = getattr(cfg, "tp", None)
+    if tp is not None and tp.gather_logits and head is not None:
+        # column-parallel head (tied heads stay replicated): each shard
+        # holds a contiguous vocab slice; gathering in-program keeps the
+        # fused sampler device-side on replicated [..., V] logits
+        from ..distributed.tp_overlap import gather_columns
+
+        logits = gather_columns(logits, tp.axis)
     if quant_kv:
         return logits, new_k, new_v, new_ks, new_vs
     return logits, new_k, new_v

@@ -76,6 +76,16 @@ GATED_METRICS: Dict[str, List[Tuple]] = {
         ("extras.train_step_hlo_collectives.all_reduce.bytes", "lower",
          DEFAULT_GATE_PCT),
     ],
+    # TP-sharded serving (ISSUE 16): tok/s at the top TP degree, the
+    # 1->4 scaling ratio at fixed per-request work (the compute/KV
+    # split claim), and the overlap mode's exposed comm ms/step — the
+    # tiled-psum decomposition must keep it strictly under the
+    # sequential baseline (asserted in-run; the gate keeps it from
+    # creeping back). A 0.0 baseline reads "not comparable", so the
+    # near-zero overlap ideal never self-gates
+    "serving_tp": [("value", "higher"),
+                   ("extras.scaling_tp4", "higher"),
+                   ("extras.exposed_ms_per_step", "lower")],
     # elastic training (ISSUE 15): recovery wall-clock from the injected
     # pod kill to the first post-resume train step (detect + fence +
     # quorum + rebuild/compile at the new world + reshard-on-load) must
@@ -107,6 +117,10 @@ SCENARIO_GATE_PCT: Dict[str, float] = {
     # closed-loop burst walls on the same contended box: the in-run
     # concurrency/agreement/parity asserts are the hard contract
     "serving_quant": 25.0,
+    # sleep-floored paired-trial walls on the contended 2-core box, same
+    # rationale as serving_fleet; the in-run scaling + exposed-ordering
+    # asserts are the hard contract
+    "serving_tp": 25.0,
     # recovery wall is dominated by ONE XLA recompile of the train step
     # at the new world size — compile walls on the contended 2-core box
     # swing ~±30% run-to-run; the in-run parity/reform asserts are the

@@ -51,13 +51,15 @@ from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
 from .slo import SLOClass, SLOConfig
 from .spec import (DraftEngineProposer, NGramProposer, Proposer,
                    SpecDecodeConfig)
+from .tp import ShardedEngine, ShardingConfigError, shard_engine
 
 __all__ = [
     "AdmissionConfig", "DraftEngineProposer", "EngineCore", "EngineStalled",
     "EngineStepError", "FleetHandle", "FleetRouter", "MLPLMEngine",
     "NGramProposer", "Proposer", "ReplicaHandle", "Request",
     "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
-    "ServingFrontend", "ServingMetrics", "SLOClass", "SLOConfig",
-    "SpecDecodeConfig", "WatchdogConfig", "greedy_agreement",
-    "quant_summary", "quantize_engine",
+    "ServingFrontend", "ServingMetrics", "ShardedEngine",
+    "ShardingConfigError", "SLOClass", "SLOConfig", "SpecDecodeConfig",
+    "WatchdogConfig", "greedy_agreement", "quant_summary",
+    "quantize_engine", "shard_engine",
 ]

@@ -133,7 +133,7 @@ def _mlp_decode(params, cache, tokens, ctx_lens, tables, *, block_size):
 
 
 def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
-                      block_size, cache_scale=None):
+                      block_size, cache_scale=None, tp=None):
     """Shared ragged body: packed tokens [T] + per-lane (q_len, kv_len)
     metadata. Token t embeds, writes its embedding at its absolute
     position (guard slots' writes are OOB-dropped), and conditions on
@@ -143,7 +143,15 @@ def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
     `cache_scale` ([NB, BS] f32) marks an int8-quantized embedding pool
     (`inference/kv_quant.py`): writes quantize per slot, the gathered
     window dequantizes right after the gather — the float pool never
-    exists. Returns (logits, cache[, cache_scale])."""
+    exists. Returns (logits, cache[, cache_scale]).
+
+    `tp` (`distributed.tp_overlap.TPInfo`, set by `serving/tp.py` when
+    the body runs inside shard_map) marks a feature-sharded pool: each
+    shard writes/gathers its contiguous D/tp embedding slice, the int8
+    scale quantizes over the FULL feature vector (absmax is a global
+    reduction — sharding it would change the scale and break bitwise
+    parity) and the plane stays replicated, and the head runs w1
+    row-parallel / w2 column-parallel (`_mlp_head`)."""
     import jax.numpy as jnp
 
     from ..inference import kv_quant
@@ -154,11 +162,21 @@ def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
     maxb = tables.shape[1]
     tok_lane, tok_pos = ragged_metadata(q_lens, kv_lens, t)
     x = jnp.take(params["embed"], tokens, axis=0)            # [T, D]
+    if tp is not None:
+        import jax
+
+        dl = cache.shape[-1]                  # local feature width D/tp
+        off = jax.lax.axis_index(tp.axis) * dl
+        x_loc = jax.lax.dynamic_slice_in_dim(x, off, dl, axis=1)
+    else:
+        x_loc = x
     pos = jnp.maximum(tok_pos, 0)
     blocks = tables[tok_lane, pos // block_size]             # [T]
     blocks = jnp.where(tok_pos >= 0, blocks, jnp.int32(nb))  # OOB -> drop
     if cache_scale is not None:
         q, s = kv_quant.quantize_kv(x)                       # [T, D] / [T]
+        if tp is not None:
+            q = jax.lax.dynamic_slice_in_dim(q, off, dl, axis=1)
         cache = cache.at[blocks, pos % block_size].set(q)
         cache_scale = cache_scale.at[blocks, pos % block_size].set(s)
         window = kv_quant.dequantize_kv(
@@ -166,7 +184,7 @@ def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
             jnp.take(cache_scale, tables, axis=0)).reshape(
                 tables.shape[0], maxb * block_size, -1)      # [B, W, D]
     else:
-        cache = cache.at[blocks, pos % block_size].set(x)
+        cache = cache.at[blocks, pos % block_size].set(x_loc)
         window = jnp.take(cache, tables, axis=0).reshape(
             tables.shape[0], maxb * block_size, -1)          # [B, W, D]
     window = jnp.take(window, tok_lane, axis=0)              # [T, W, D]
@@ -174,14 +192,14 @@ def _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens, tables, *,
     mask = (wpos[None, :] <= tok_pos[:, None]).astype(x.dtype)
     mean = (window * mask[..., None]).sum(1) / jnp.maximum(
         mask.sum(1, keepdims=True), 1.0)                     # [T, D]
-    logits = _mlp_head(params, x, mean)
+    logits = _mlp_head(params, x_loc, mean, tp=tp)
     if cache_scale is not None:
         return logits.astype(jnp.float32), cache, cache_scale
     return logits.astype(jnp.float32), cache
 
 
 def _mlp_ragged(params, cache, tokens, q_lens, kv_lens, tables, *,
-                block_size):
+                block_size, tp=None):
     from ..framework import monitor
 
     # trace-time only — the ragged step IS the serving decode program
@@ -190,11 +208,11 @@ def _mlp_ragged(params, cache, tokens, q_lens, kv_lens, tables, *,
     monitor.inc("serving.decode_retraces")
     monitor.inc("serving.ragged_retraces")
     return _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens,
-                             tables, block_size=block_size)
+                             tables, block_size=block_size, tp=tp)
 
 
 def _mlp_ragged_q(params, cache, cache_scale, tokens, q_lens, kv_lens,
-                  tables, *, block_size):
+                  tables, *, block_size, tp=None):
     """The int8-pool ragged step (`kv_bits=8`): the scale plane rides
     (and is donated) alongside the cache."""
     from ..framework import monitor
@@ -203,10 +221,11 @@ def _mlp_ragged_q(params, cache, cache_scale, tokens, q_lens, kv_lens,
     monitor.inc("serving.ragged_retraces")
     return _mlp_ragged_stack(params, cache, tokens, q_lens, kv_lens,
                              tables, block_size=block_size,
-                             cache_scale=cache_scale)
+                             cache_scale=cache_scale, tp=tp)
 
 
-def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
+def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size,
+                tp=None):
     """Speculative verify as a special case of the ragged step: every
     lane is a fixed q_len == S window of the packed buffer."""
     import jax.numpy as jnp
@@ -218,12 +237,12 @@ def _mlp_verify(params, cache, tokens, ctx_lens, tables, *, block_size):
     q_lens = jnp.full((b,), s, jnp.int32)
     logits, cache = _mlp_ragged_stack(
         params, cache, tokens.reshape(b * s), q_lens,
-        ctx_lens.astype(jnp.int32), tables, block_size=block_size)
+        ctx_lens.astype(jnp.int32), tables, block_size=block_size, tp=tp)
     return logits.reshape(b, s, -1), cache
 
 
 def _mlp_verify_q(params, cache, cache_scale, tokens, ctx_lens, tables, *,
-                  block_size):
+                  block_size, tp=None):
     """Verify over the int8 pool (rides the quantized ragged stack)."""
     import jax.numpy as jnp
 
@@ -235,7 +254,7 @@ def _mlp_verify_q(params, cache, cache_scale, tokens, ctx_lens, tables, *,
     logits, cache, cache_scale = _mlp_ragged_stack(
         params, cache, tokens.reshape(b * s), q_lens,
         ctx_lens.astype(jnp.int32), tables, block_size=block_size,
-        cache_scale=cache_scale)
+        cache_scale=cache_scale, tp=tp)
     return logits.reshape(b, s, -1), cache, cache_scale
 
 
@@ -254,13 +273,32 @@ def _mlp_mm(h, w):
     return dequant_matmul(h, w["q"], w["s"])
 
 
-def _mlp_head(params, last, mean):
+def _mlp_head(params, last, mean, tp=None):
+    """`gelu([last, mean] @ w1 + b1) @ w2 + b2`.
+
+    Under TP (`tp` set, inside shard_map): `last`/`mean` are the local
+    feature slices, `w1` is the matching row-parallel shard (rows
+    permuted by `serving/tp.py` so shard s holds [last_s, mean_s]) whose
+    partial sums psum-reduce tile-by-tile — tile k's collective overlaps
+    tile k+1's gemm (`distributed/tp_overlap.py`) — and `w2`/`b2` are
+    column-parallel vocab shards; `tp.gather_logits` finishes with an
+    in-program all-gather so the sampler sees replicated logits."""
     import jax
     import jax.numpy as jnp
 
     h = jnp.concatenate([last, mean], axis=-1)
-    h = jax.nn.gelu(_mlp_mm(h, params["w1"]) + params["b1"])
-    return _mlp_mm(h, params["w2"]) + params["b2"]
+    if tp is None:
+        h = jax.nn.gelu(_mlp_mm(h, params["w1"]) + params["b1"])
+        return _mlp_mm(h, params["w2"]) + params["b2"]
+    from ..distributed.tp_overlap import gather_columns, row_parallel_matmul
+
+    h = jax.nn.gelu(
+        row_parallel_matmul(h, params["w1"], axis_name=tp.axis,
+                            ntiles=tp.tiles, mm=_mlp_mm) + params["b1"])
+    logits = _mlp_mm(h, params["w2"]) + params["b2"]
+    if tp.gather_logits:
+        logits = gather_columns(logits, tp.axis)
+    return logits
 
 
 class MLPLMEngine:

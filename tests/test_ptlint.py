@@ -795,6 +795,37 @@ class TestHLOAudit:
         assert "collective_ops 1 > budget 0" in kinds
         assert "f32 gemm" in kinds and "op_budget: dot" in kinds
 
+    def test_collective_budget_and_bytes_directions(self):
+        """The ISSUE-16 per-kind keys: `collective_budget` caps each
+        collective KIND (a new kind entering the program is a finding
+        even under the total-op cap) and `collective_bytes_max` caps the
+        summed payload. SYNTHETIC_HLO: one all-reduce of f32[16] =
+        64 bytes."""
+        # honest: the present kind budgeted, bytes exactly at cap
+        actuals, findings = audit_text(SYNTHETIC_HLO, {
+            "host_transfer_ops_max": 1, "collective_ops_max": 1,
+            "collective_budget": {"all_reduce": 1},
+            "collective_bytes_max": 64, "declared_dtype": "f32"})
+        assert findings == []
+        assert actuals["collective_bytes"] == 64
+        assert actuals["collective_census"]["all_reduce"]["ops"] == 1
+        # doctored: all_reduce unbudgeted (only all_gather declared) and
+        # the byte cap one under the payload — both directions fire
+        _a, findings = audit_text(SYNTHETIC_HLO, {
+            "host_transfer_ops_max": 1, "collective_ops_max": 1,
+            "collective_budget": {"all_gather": 1},
+            "collective_bytes_max": 63, "declared_dtype": "f32"})
+        text = "\n".join(findings)
+        assert len(findings) == 2
+        assert "unbudgeted collective kind 'all_reduce'" in text
+        assert "collective_bytes 64 > budget 63" in text
+        # per-kind over-cap: the kind is declared but exceeds its budget
+        _a, findings = audit_text(SYNTHETIC_HLO, {
+            "host_transfer_ops_max": 1, "collective_ops_max": 1,
+            "collective_budget": {"all_reduce": 0},
+            "declared_dtype": "f32"})
+        assert any("all_reduce x1 > budget 0" in f for f in findings)
+
     def test_unknown_manifest_key_is_config_error(self):
         with pytest.raises(ManifestError):
             audit_text(SYNTHETIC_HLO, {"host_transfers_max": 0})

@@ -15,7 +15,11 @@ then asserts the layer's artifacts (ISSUE 9 acceptance):
    straggler attribution fields;
 4. a per-device memory snapshot + KV fragmentation snapshot exist;
 5. a gated `dryrun_multichip` baseline write PASSES `tools/bench_diff.py`
-   against itself and a doctored 10 % exposed-comm regression exits 1.
+   against itself and a doctored 10 % exposed-comm regression exits 1;
+6. the tp=2 TP-sharded serving decode (ISSUE 16) leaves a populated
+   comms track with a nonzero overlap window per dispatch, the
+   sequential baseline's exposed host logit assembly records inside the
+   window, and overlap exposes strictly less than sequential.
 
 Usage: python tools/dist_obs_smoke.py
 Exit code 0 on success; prints one JSON line with the smoke's evidence.
@@ -136,6 +140,81 @@ def dryrun_with_obs(tmp):
     }
 
 
+def tp_serving_pass(tmp):
+    """TP-sharded serving under the same observability layer (ISSUE 16):
+    a tp=2 `ShardedEngine` decode leaves a populated comms track with a
+    NONZERO step-overlap window per dispatch; the sequential-collective
+    baseline's host logit assembly is recorded as an `all_gather` INSIDE
+    its step window; and the overlapped mode's median exposed-comm ms is
+    strictly below the sequential baseline's."""
+    import paddle_tpu.observability as obs
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.serving import MLPLMEngine, shard_engine
+
+    kw = dict(vocab_size=2048, hidden=32, max_batch_size=4, num_blocks=32,
+              block_size=4, max_blocks_per_seq=4, seed=0)
+
+    def args(step):
+        q = np.array([1, 1, 2, 0], np.int32)
+        kv = np.array([2 + step, 1 + step, 2, 0], np.int32)
+        toks = (np.arange(8, dtype=np.int32) * 3 + step) % 2048
+        tables = np.arange(16, dtype=np.int32).reshape(4, 4)
+        return toks.astype(np.int32), q, kv, tables
+
+    engines = {
+        "overlap": shard_engine(MLPLMEngine(**kw), tp=2, overlap=True,
+                                overlap_tiles=2),
+        "sequential": shard_engine(MLPLMEngine(**kw), tp=2,
+                                   overlap=False),
+    }
+    for eng in engines.values():     # compiles land OUTSIDE the windows
+        eng.ragged_step(*args(0))
+    obs.enable()
+    obs.reset()
+    monitor.reset_prefix("comm.")
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    exposed = {}
+    for mode, eng in engines.items():
+        samples = []
+        for s in range(5):
+            eng.ragged_step(*args(s + 1))
+            samples.append(monitor.get("comm.exposed_ms_per_step"))
+        exposed[mode] = sorted(samples)[len(samples) // 2]
+    prof.stop()
+
+    # export BEFORE obs.disable(): the comms track renders only while
+    # observability is on (same order as dryrun_with_obs)
+    trace_path = os.path.join(tmp, "tp_serving_trace.json")
+    prof.export(trace_path)
+    obs.disable()
+    ev = [e for e in json.load(open(trace_path))["traceEvents"]
+          if e.get("pid") == "comms" and e.get("ph") != "M"]
+    steps = [e for e in ev if e["cat"] == "step"
+             and e["name"] == "serving.ragged_step_tp2"]
+    assert len(steps) == 10, \
+        f"expected one step window per dispatch, got {len(steps)}"
+    assert all(s["dur"] > 0 for s in steps), \
+        "a decode step-overlap window collapsed to zero duration"
+    gathers = [e for e in ev if e["cat"] == "comm"
+               and e["name"] == "all_gather"]
+    assert len(gathers) == 5, \
+        f"sequential host assembly should trace 5 all_gathers: {gathers}"
+    assert all(any(s["ts"] <= g["ts"] <= s["ts"] + s["dur"]
+                   for s in steps) for g in gathers), \
+        "an all_gather record fell outside every decode step window"
+    snap = monitor.snapshot("comm.", include_histograms=False)
+    assert snap.get("comm.all_gather.bytes", 0) > 0, snap
+    assert exposed["overlap"] < exposed["sequential"], exposed
+    return {
+        "tp_step_windows": len(steps),
+        "tp_exposed_ms_overlap": exposed["overlap"],
+        "tp_exposed_ms_sequential": exposed["sequential"],
+        "tp_all_gather_bytes": snap["comm.all_gather.bytes"],
+    }
+
+
 def bench_gate(tmp):
     """Self-baseline passes; doctored regressions fail (exit 1) under
     the dryrun_multichip GATED_METRICS: exposure/bandwidth carry the
@@ -201,6 +280,7 @@ def main():
     t0 = time.time()
     with tempfile.TemporaryDirectory() as tmp:
         out = dryrun_with_obs(tmp)
+        out.update(tp_serving_pass(tmp))
         out.update(bench_gate(tmp))
     out["wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(out))

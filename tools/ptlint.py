@@ -244,9 +244,16 @@ def _by_pass(findings) -> dict:
 
 def _run_hlo_audit(args) -> int:
     """Tier B rides the real package (it must build engines), so jax
-    loads here — and only here."""
+    loads here — and only here. The TP-sharded executables
+    (`ragged_decode_tp`) need a multi-device topology, so the CPU
+    backend is forced to 8 virtual devices BEFORE jax initializes (the
+    same trick tests/conftest.py and tools/dist_obs_smoke.py use)."""
     sys.path.insert(0, _REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     from paddle_tpu.analysis import hlo_audit
 
     manifest_path = args.manifest or hlo_audit.DEFAULT_MANIFEST
