@@ -1842,6 +1842,265 @@ def serving_tp_main():
     }, "serving_tp")
 
 
+@scenario("serving_disagg", 420)
+def serving_disagg_main():
+    """`python bench.py serving_disagg` — the disaggregated-serving
+    acceptance instrument (ISSUE 17): 2 prefill + 2 decode replicas vs 4
+    colocated replicas on the SAME deterministic trace (steady decode
+    lanes, then a long-prompt storm).
+
+    What it measures: the tier isolation the architecture buys. Each
+    replica's engine carries a simulated device-latency profile — a
+    fixed decode-step floor plus a per-prefill-token surcharge
+    (deadline-corrected GIL-released sleep, the `serving_fleet`
+    convention) — so a CPU CI box reproduces the interference physics:
+    a replica whose ragged round carries prefill chunks stretches every
+    decode lane sharing that round. Colocated, the storm lands on every
+    replica and steady-lane TPOT inflates toward the `serving_mixed`
+    floor (>= 1.10x asserted — without the contrast the headline is
+    meaningless). Disaggregated, the decode tier never sees a prompt
+    chunk and its storm-window TPOT must hold <= 1.02x steady.
+
+    Decode TPOT is measured per REPLICA step wall (a running lane
+    commits exactly one token per its replica's round), so the
+    synchronous router driver's barrier doesn't leak the prefill tier's
+    wall into the decode tier's number. Fleet efficiency is gated as
+    tokens per device-busy-second (the device-time a fleet actually
+    pays for): disaggregation packs the decode tier denser, so it must
+    be >= the colocated run's. Also asserted in-run: zero ragged
+    retraces on BOTH tiers across the measured windows, and every
+    steady lane finishing on the decode tier with bitwise-identical
+    streams across the two configs.
+
+    Run SOLO, outside the tier-1 window (the 870 s box truncates).
+    """
+    probe = _scenario_setup("serving_disagg")
+    import jax
+    import numpy as np
+
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.serving import (DisaggRouter, FleetRouter,
+                                    HandoffState, MLPLMEngine,
+                                    RequestStatus, ServingMetrics)
+
+    decode_ms = float(os.environ.get("BENCH_DISAGG_DECODE_MS", "25"))
+    prefill_tok_ms = float(
+        os.environ.get("BENCH_DISAGG_PREFILL_TOK_MS", "0.5"))
+    storm_len = int(os.environ.get("BENCH_DISAGG_STORM_PROMPT", "192"))
+    chunk = int(os.environ.get("BENCH_DISAGG_CHUNK", "32"))
+    n_lanes = int(os.environ.get("BENCH_DISAGG_LANES", "8"))
+    n_storm = int(os.environ.get("BENCH_DISAGG_STORM", "8"))
+    # long enough that every steady lane outlives the whole storm
+    # window — the TPOT samples must come from RUNNING decode lanes
+    steady_new = int(os.environ.get("BENCH_DISAGG_MAX_NEW", "96"))
+    max_tpot_x = float(os.environ.get("BENCH_DISAGG_MAX_TPOT_X", "1.02"))
+    min_colo_x = float(os.environ.get("BENCH_DISAGG_MIN_COLO_X", "1.10"))
+
+    class _InterferenceEngine:
+        """MLP engine whose ragged dispatch walls like a real chip:
+        `decode_s` floor per round, plus `tok_s` per prefill token in
+        the round (lanes with q > 1). Decode-only rounds stay at the
+        floor; prefill-carrying rounds stretch — the interference the
+        disaggregation is supposed to remove. `busy_s` accumulates the
+        device-busy wall this replica actually spent."""
+
+        def __init__(self, inner, decode_s, tok_s):
+            self._inner = inner
+            self._decode_s = decode_s
+            self._tok_s = tok_s
+            self.busy_s = 0.0
+            self.walls_ms = []          # per-dispatch device wall
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def ragged_step(self, tokens, q_lens, kv_lens, tables):
+            t0 = time.perf_counter()
+            out = self._inner.ragged_step(tokens, q_lens, kv_lens, tables)
+            jax.block_until_ready(out)
+            compute = time.perf_counter() - t0
+            q = np.asarray(q_lens)
+            target = self._decode_s + self._tok_s * int(q[q > 1].sum())
+            time.sleep(max(0.0, target - compute))
+            # the DEVICE wall is the simulated profile (or the real
+            # compute when it spills past it) — sleep overshoot under
+            # host thread contention is emulator noise, not serving
+            # behavior, and must not leak into the TPOT samples
+            wall = max(target, compute)
+            self.busy_s += wall
+            self.walls_ms.append(wall * 1e3)
+            return out
+
+        def respawn(self):
+            e = _InterferenceEngine(self._inner.respawn(),
+                                    self._decode_s, self._tok_s)
+            e.busy_s = self.busy_s
+            return e
+
+    def make_factory(pool):
+        def factory():
+            e = _InterferenceEngine(
+                MLPLMEngine(vocab_size=256, hidden=32, max_batch_size=8,
+                            num_blocks=320, block_size=4,
+                            max_blocks_per_seq=64, seed=0),
+                decode_ms / 1e3, prefill_tok_ms / 1e3)
+            pool.append(e)
+            return e
+        return factory
+
+    rng = np.random.default_rng(0)
+    lane_ps = [rng.integers(1, 256, 12).tolist() for _ in range(n_lanes)]
+    storm_ps = [rng.integers(1, 256, storm_len).tolist()
+                for _ in range(n_storm)]
+    fkw = dict(prefill_chunk_tokens=chunk)
+
+    def run_config(router, engines, lanes_on):
+        """The shared trace on a warm router. Returns (tpot dict,
+        token-streams, tokens); device-busy is read by the caller."""
+        # warm EVERY replica's executables + (disagg) the handoff
+        # gather/scatter pair; least-loaded placement spreads these
+        for p in (lane_ps * 2)[:2 * len(router.replicas)]:
+            router.submit(p, max_new_tokens=2)
+        router.run_until_idle()
+        monitor.reset("serving.ragged_retraces")
+        lanes = [router.submit(p, max_new_tokens=steady_new)
+                 for p in lane_ps]
+        # settle: prefills done, (disagg) every lane handed off — the
+        # measured windows see pure steady-state decode placement
+        for _ in range(400):
+            if all(len(h._req.generated) >= 2 and h._replica is not None
+                   and lanes_on(h) for h in lanes):
+                break
+            router.step()
+        else:
+            raise RuntimeError("steady lanes never settled")
+        # decode TPOT = the DEVICE dispatch wall of the replicas hosting
+        # the steady lanes (a running lane commits one token per its
+        # replica's dispatch): spawn order == factory-call order, so
+        # replicas zip with the engine pool
+        eng_by_id = {rep.replica_id: e
+                     for rep, e in zip(router.replicas, engines)}
+        hosts = [eng_by_id[h._replica.replica_id]
+                 for h in lanes if h._replica is not None]
+        hosts = list({id(e): e for e in hosts}.values())
+
+        def window(until):
+            marks = [len(e.walls_ms) for e in hosts]
+            for _ in range(2000):
+                if until():
+                    break
+                router.step()
+            else:
+                raise RuntimeError("measurement window never completed")
+            return [w for e, m in zip(hosts, marks)
+                    for w in e.walls_ms[m:]]
+
+        rounds = iter(range(20))
+        steady = window(lambda: next(rounds, None) is None)
+        storm = [router.submit(p, max_new_tokens=2) for p in storm_ps]
+        # a request's _prefill_ctx only materializes when first
+        # scheduled (the serving_mixed guard): unscheduled != done
+        still_prefilling = lambda h: not h.status.terminal and (  # noqa: E731
+            h._req.prefilling or not h._req._prefill_ctx.size)
+        during = window(
+            lambda: not any(still_prefilling(h) for h in storm))
+        router.run_until_idle()
+        hs = lanes + storm
+        bad = [h for h in hs if h.status is not RequestStatus.FINISHED]
+        assert not bad, f"non-finished requests: {bad[:3]}"
+        assert len(during) >= 8, \
+            f"storm window produced {len(during)} decode-lane TPOT " \
+            f"samples: lanes died before the storm, nothing was measured"
+        p99 = lambda xs: float(np.percentile(np.asarray(xs), 99))  # noqa: E731
+        tpot = {
+            "steady_tpot_p99_ms": round(p99(steady), 3),
+            "storm_tpot_p99_ms": round(p99(during), 3),
+            "tpot_degradation_x": round(p99(during) / p99(steady), 3),
+            "storm_rounds": len(during),
+        }
+        return tpot, [h.tokens for h in lanes], sum(
+            len(h.tokens) for h in hs)
+
+    results = {}
+    for mode in ("disagg", "colocated"):
+        ServingMetrics.reset_monitor()
+        monitor.reset_prefix("fleet.")
+        engines = []
+        if mode == "disagg":
+            router = DisaggRouter(make_factory(engines), num_prefill=2,
+                                  num_decode=2, parallel=True,
+                                  heartbeat_every=64, sweep_every=512,
+                                  frontend_kwargs=fkw)
+            decode_tier = set(router.fleet_summary()["tiers"]["decode"])
+            lanes_on = lambda h: (h._replica.replica_id  # noqa: E731
+                                  in decode_tier)
+        else:
+            router = FleetRouter(make_factory(engines), num_replicas=4,
+                                 parallel=True, heartbeat_every=64,
+                                 sweep_every=512, frontend_kwargs=fkw)
+            lanes_on = lambda h: True  # noqa: E731
+        try:
+            tpot, streams, toks = run_config(router, engines, lanes_on)
+            retraces = monitor.get("serving.ragged_retraces")
+            fs = router.fleet_summary()
+            if mode == "disagg":
+                assert fs["counters"].get("fleet.handoffs", 0) > 0, \
+                    "disagg run moved no sessions prefill->decode"
+                assert fs["counters"].get(
+                    "fleet.handoff_fallbacks", 0) == 0, \
+                    f"clean run fell back to re-prefill: {fs['counters']}"
+            assert retraces == 0, \
+                f"{mode}: {retraces} ragged retraces in steady state"
+            results[mode] = {
+                **tpot,
+                "tok_per_device_s": round(
+                    toks / sum(e.busy_s for e in engines), 1),
+                "tokens": toks,
+                "handoffs": fs["counters"].get("fleet.handoffs", 0),
+                "streams": streams,
+            }
+        finally:
+            router.close()
+
+    dis, colo = results["disagg"], results["colocated"]
+    # identical trace, identical greedy streams: disaggregation must be
+    # invisible in the tokens
+    assert dis.pop("streams") == colo.pop("streams"), \
+        "steady-lane streams differ between disagg and colocated"
+    assert colo["tpot_degradation_x"] >= min_colo_x, \
+        f"colocated floor {colo['tpot_degradation_x']}x < {min_colo_x}x: " \
+        f"the storm shows no interference, the contrast is meaningless"
+    assert dis["tpot_degradation_x"] <= max_tpot_x, \
+        f"decode-tier TPOT degraded {dis['tpot_degradation_x']}x > " \
+        f"{max_tpot_x}x under the prefill storm: the tier is not isolated"
+    assert dis["tok_per_device_s"] >= colo["tok_per_device_s"], \
+        f"disagg fleet efficiency {dis['tok_per_device_s']} tok/device-s " \
+        f"< colocated {colo['tok_per_device_s']}: specialization is " \
+        f"wasting the fleet"
+    extras = {
+        "disagg": dis,
+        "colocated": colo,
+        "tpot_degradation_x": dis["tpot_degradation_x"],
+        "colocated_tpot_degradation_x": colo["tpot_degradation_x"],
+        "simulated_decode_step_ms": decode_ms,
+        "simulated_prefill_tok_ms": prefill_tok_ms,
+        "storm_prompt_tokens": storm_len,
+        "prefill_chunk_tokens": chunk,
+        "probe": probe,
+        "device": jax.devices()[0].device_kind or "cpu",
+    }
+    _emit_report({
+        "metric": "serving_disagg_tok_s",
+        "value": dis["tok_per_device_s"],
+        "unit": f"fleet tok per device-busy-s, 2 prefill + 2 decode "
+                f"(decode TPOT under storm {dis['tpot_degradation_x']}x "
+                f"steady vs {colo['tpot_degradation_x']}x colocated; "
+                f"{decode_ms} ms simulated decode step)",
+        "vs_baseline": None,
+        "extras": extras,
+    }, "serving_disagg")
+
+
 @scenario("kernel_micro", 300)
 def kernel_micro_main():
     """`python bench.py kernel_micro` — paged-attention kernel microbench

@@ -396,6 +396,44 @@ def _exe_train_step():
         (params, moments, x, y)
 
 
+def _exe_kv_extract():
+    """The KV-block EXPORT gather (ISSUE 17): `MLPLMEngine._kv_gather`,
+    the one compiled executable behind `extract_kv_blocks`. Pool x
+    padded block-index vector -> contiguous slab; a disaggregated
+    handoff is exactly one dispatch of this on the prefill tier. It
+    must compile to a pure device copy: zero collectives on a single
+    chip, zero host transfers — the payload crosses the host boundary
+    AFTER this program returns, as one declared slab, never op-by-op
+    from inside the executable."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+
+    eng = MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                      num_blocks=16, block_size=4, max_blocks_per_seq=4)
+    idx = np.zeros((4,), np.int32)
+    return eng._kv_gather, (eng.cache, idx)
+
+
+def _exe_kv_inject():
+    """The KV-block IMPORT scatter (ISSUE 17): `MLPLMEngine._kv_scatter`
+    with a DONATED destination pool — `inject_kv_blocks` lands a
+    migrated slab into freshly-allocated blocks in place (no second
+    pool copy). Same boundary contract as the gather: the slab arrives
+    as one declared argument; the compiled program itself moves no
+    bytes to or from the host and speaks to no other chip."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+
+    eng = MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                      num_blocks=16, block_size=4, max_blocks_per_seq=4)
+    idx = np.zeros((4,), np.int32)
+    slab = np.zeros((4,) + tuple(eng.cache.shape[1:]),
+                    np.dtype(eng.cache.dtype))
+    return eng._kv_scatter, (eng.cache, idx, slab)
+
+
 EXECUTABLES = {
     "ragged_decode": _exe_ragged_decode,
     "ragged_decode_quant": _exe_ragged_decode_quant,
@@ -405,6 +443,8 @@ EXECUTABLES = {
     "verify_tp": _exe_verify_tp,
     "sampler": _exe_sampler,
     "train_step": _exe_train_step,
+    "kv_extract": _exe_kv_extract,
+    "kv_inject": _exe_kv_inject,
 }
 
 

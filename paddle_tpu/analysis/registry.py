@@ -67,6 +67,18 @@ HOT_PATHS = {
     # write per train step — a per-call device_put/import/extra blocking
     # call here lands on EVERY step of every supervised training run
     ("resilience/elastic_train.py", "ElasticTrainSupervisor._beat"),
+    # KV-block migration (ISSUE 17): extract/inject are one compiled
+    # gather/scatter each, dispatched per handoff and per KV-shipping
+    # relocation — per-call host conversions or blocking I/O here would
+    # put a wall between the tiers; the disagg pump wraps them once per
+    # router step
+    ("serving/engine.py", "MLPLMEngine.extract_kv_blocks"),
+    ("serving/engine.py", "MLPLMEngine.inject_kv_blocks"),
+    ("inference/llama_runner.py", "LlamaInferenceEngine.extract_kv_blocks"),
+    ("inference/llama_runner.py", "LlamaInferenceEngine.inject_kv_blocks"),
+    ("serving/tp.py", "ShardedEngine.extract_kv_blocks"),
+    ("serving/tp.py", "ShardedEngine.inject_kv_blocks"),
+    ("serving/disagg.py", "DisaggRouter._pump_handoffs"),
 }
 
 # ---------------------------------------------------------------------------
@@ -141,6 +153,7 @@ THREADED_MODULES = (
     "resilience/elastic_train.py",   # heartbeat ticker + supervisor
     "resilience/faults.py",
     "serving/fleet.py",
+    "serving/disagg.py",   # inherits the router's threaded step fan-out
     "distributed/elastic/",
     "distributed/checkpoint/save_state_dict.py",
 )

@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..models.llama import LlamaForCausalLM
+from . import kv_migrate
 from .cache import BlockCacheManager
 
 __all__ = ["LlamaInferenceEngine", "GenerationConfig"]
@@ -241,6 +242,94 @@ class LlamaInferenceEngine:
             lambda k, v, s, d: (k.at[:, d].set(k[:, s]),
                                 v.at[:, d].set(v[:, s])),
             donate_argnums=(0, 1))
+        # KV migration (inference/kv_migrate.py): fixed-shape gather/
+        # scatter over [max_blocks_per_seq] padded index vectors on the
+        # block axis (axis 1, all layers at once). Gather NOT donated —
+        # the source pool lives on; scatter donates the destination
+        # pools. Int8 pools move K/V and BOTH scale planes in the same
+        # executable so quantized state never tears apart in flight.
+        if self.kv_bits == 8:
+            self._kv_gather = jax.jit(
+                lambda k, v, ks, vs, i: (k[:, i], v[:, i], ks[:, i],
+                                         vs[:, i]))
+            self._kv_scatter = jax.jit(
+                lambda k, v, ks, vs, i, sk, sv, sks, svs: (
+                    k.at[:, i].set(sk), v.at[:, i].set(sv),
+                    ks.at[:, i].set(sks), vs.at[:, i].set(svs)),
+                donate_argnums=(0, 1, 2, 3))
+        else:
+            self._kv_gather = jax.jit(
+                lambda k, v, i: (k[:, i], v[:, i]))
+            self._kv_scatter = jax.jit(
+                lambda k, v, i, sk, sv: (k.at[:, i].set(sk),
+                                         v.at[:, i].set(sv)),
+                donate_argnums=(0, 1))
+        self._mig_header = {
+            "version": kv_migrate.PAYLOAD_VERSION, "engine": "llama",
+            "block_size": block_size,
+            "max_blocks_per_seq": max_blocks_per_seq,
+            "kv_bits": self.kv_bits, "tp": 1, "num_layers": L,
+            "kv_heads": kvh, "head_dim": d,
+            "dtype": str(self.k_cache.dtype),
+        }
+
+    def extract_kv_blocks(self, seq_id: int) -> kv_migrate.KVBlockPayload:
+        """Export `seq_id`'s committed KV blocks across all layers as ONE
+        device gather (disaggregated handoff / KV-shipping relocation,
+        ISSUE 17). The source pools are untouched — extraction is a
+        copy; indices pad to the fixed `max_blocks_per_seq` shape so
+        every sequence length rides one compiled executable."""
+        mgr = self.manager
+        blocks = mgr.blocks_of(seq_id)
+        if not blocks:
+            raise kv_migrate.KVMigrationError(
+                f"sequence {seq_id} holds no KV blocks on this engine")
+        idx = kv_migrate.pad_block_indices(blocks, mgr.max_blocks_per_seq)
+        header = dict(self._mig_header, num_blocks=len(blocks),
+                      num_tokens=mgr.seq_len(seq_id))
+        if self.kv_bits == 8:
+            sk, sv, sks, svs = self._kv_gather(
+                self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                idx)
+            return kv_migrate.KVBlockPayload(
+                header, {"k": sk, "v": sv, "k_scale": sks,
+                         "v_scale": svs})
+        sk, sv = self._kv_gather(self.k_cache, self.v_cache, idx)
+        return kv_migrate.KVBlockPayload(header, {"k": sk, "v": sv})
+
+    def inject_kv_blocks(self, seq_id: int,
+                         payload: kv_migrate.KVBlockPayload) -> None:
+        """Import a migrated payload under `seq_id`: typed header
+        validation BEFORE any allocation, the manager's typed capacity
+        errors propagate from `allocate`, one donated scatter writes
+        every layer; any post-allocation failure frees the blocks so a
+        failed inject never leaks. Payload slabs are not donated (one
+        payload can stream to several workers)."""
+        mgr = self.manager
+        kv_migrate.check_header(payload.header, self._mig_header)
+        blocks = mgr.allocate(seq_id, payload.num_tokens)
+        try:
+            if len(blocks) != payload.num_blocks:
+                raise kv_migrate.KVMigrationError(
+                    f"payload carries {payload.num_blocks} blocks but "
+                    f"{payload.num_tokens} tokens allocate "
+                    f"{len(blocks)} here")
+            idx = kv_migrate.pad_block_indices(blocks,
+                                               mgr.max_blocks_per_seq)
+            if self.kv_bits == 8:
+                (self.k_cache, self.v_cache, self.k_scale,
+                 self.v_scale) = self._kv_scatter(
+                    self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, idx, payload.slabs["k"],
+                    payload.slabs["v"], payload.slabs["k_scale"],
+                    payload.slabs["v_scale"])
+            else:
+                self.k_cache, self.v_cache = self._kv_scatter(
+                    self.k_cache, self.v_cache, idx,
+                    payload.slabs["k"], payload.slabs["v"])
+        except Exception:
+            mgr.free(seq_id)
+            raise
 
     def cost_card_args(self, phase: str):
         """Observability hook (`observability.costs.ensure_engine_card`):

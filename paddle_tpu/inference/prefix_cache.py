@@ -226,6 +226,24 @@ class RadixPrefixCache:
         blocks, _hit = self._cap(toks, blocks, hit)
         return len(blocks)
 
+    def match_export(self, tokens) -> Tuple[List[int], int]:
+        """``(blocks, hit_tokens)`` for the FULL-block cached prefix of
+        `tokens` — the cross-replica streaming export walk (ISSUE 17).
+        The lease caps don't apply: nothing is left to "run" (the
+        importer publishes into its own tree, it doesn't decode), and
+        the partial-tail match is dropped because a tree only stores
+        full blocks. `max_blocks_per_seq` still bounds the result — the
+        extract gather rides a transient lease of exactly these
+        blocks."""
+        toks = np.asarray(tokens).reshape(-1).tolist()
+        if not toks:
+            return [], 0
+        blocks, hit, _ = self._walk(toks, touch=False)
+        bs = self.manager.block_size
+        n_full = min(hit // bs, len(blocks),
+                     self.manager.max_blocks_per_seq)
+        return blocks[:n_full], n_full * bs
+
     # ---- lease / publish / evict ----
     def lease(self, seq_id: int, tokens) -> int:
         """Adopt the deepest cached prefix of `tokens` for `seq_id`

@@ -547,6 +547,16 @@ class Profiler:
                 f"sample retraces {g('serving.sample_retraces')})")
         if rejected:
             lines.append("  reject reasons: " + cls._kv_join(rejected))
+        # Disaggregated handoff block: rendered once a prefill→decode
+        # session migration landed (serving/disagg.py; docs/SERVING.md
+        # "Disaggregated prefill/decode")
+        h = lambda k: snap.get(f"serving.handoff.{k}", 0)  # noqa: E731
+        if h("count"):
+            lines.append(
+                f"  Handoffs: {h('count')} sessions streamed "
+                f"prefill→decode, {h('bytes')} KV payload bytes, "
+                f"{round(h('wall_ms') / max(1, h('count')), 3)} ms/handoff "
+                f"mean extract→inject wall")
         # Prefix cache block: only rendered once the radix cache saw an
         # admission (hits + misses > 0) — docs/SERVING.md "Prefix
         # caching & multi-tenant SLOs"

@@ -43,6 +43,16 @@ mid-burst replica-kill the fleet chaos smoke drives) and
 replica and exercises submit failover). See docs/SERVING.md "Fleet
 routing & replica failure".
 
+Disaggregated-serving site (`serving/disagg.py`): ``fleet.handoff``
+(per prefill→decode session handoff, checked at the extraction edge
+BEFORE the source releases the request). A raise fails the KV
+extraction — the session falls back to committed-prefix re-prefill
+relocation; ``action="flag"`` kills the PREFILL worker mid-handoff
+(`fail_replica` crash semantics: pool lost, every in-flight request it
+held fold-relocates from the host-side streams) — the
+`tools/serving_chaos_smoke.py` disagg scenario. See docs/SERVING.md
+"Disaggregated prefill/decode".
+
 Elastic training sites (`resilience/elastic_train.py`): ``train.step``
 (per supervised train step; ``action="flag"`` kills the busiest
 emulated pod mid-step so its collective aborts — the

@@ -39,7 +39,12 @@ Components:
   membership with incarnation-fenced heartbeats, and replica-failure
   relocation that carries committed tokens as prompt prefix, extending
   the terminal-status contract fleet-wide.
+- `DisaggRouter` (disagg.py): disaggregated prefill/decode — the fleet
+  split into role-specialized tiers, with prefill-complete sessions
+  streamed to the decode tier as migrated KV-block payloads
+  (`inference/kv_migrate.py`) instead of re-prefilled.
 """
+from .disagg import DisaggRouter, HandoffError, HandoffState
 from .engine import EngineCore, MLPLMEngine
 from .fault_tolerance import (AdmissionConfig, EngineStalled,
                               EngineStepError, WatchdogConfig)
@@ -54,8 +59,9 @@ from .spec import (DraftEngineProposer, NGramProposer, Proposer,
 from .tp import ShardedEngine, ShardingConfigError, shard_engine
 
 __all__ = [
-    "AdmissionConfig", "DraftEngineProposer", "EngineCore", "EngineStalled",
-    "EngineStepError", "FleetHandle", "FleetRouter", "MLPLMEngine",
+    "AdmissionConfig", "DisaggRouter", "DraftEngineProposer", "EngineCore",
+    "EngineStalled", "EngineStepError", "FleetHandle", "FleetRouter",
+    "HandoffError", "HandoffState", "MLPLMEngine",
     "NGramProposer", "Proposer", "ReplicaHandle", "Request",
     "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
     "ServingFrontend", "ServingMetrics", "ShardedEngine",

@@ -41,6 +41,7 @@ from typing import Optional, Protocol, runtime_checkable
 
 import numpy as np
 
+from ..inference import kv_migrate
 from ..inference.cache import BlockCacheManager
 
 __all__ = ["EngineCore", "MLPLMEngine"]
@@ -387,6 +388,29 @@ class MLPLMEngine:
         # int32 scalars, so repeated COWs never recompile
         self._copy_block = jax.jit(lambda c, s, d: c.at[d].set(c[s]),
                                    donate_argnums=(0,))
+        # KV migration (inference/kv_migrate.py): fixed-shape gather/
+        # scatter over [max_blocks_per_seq] padded index vectors — the
+        # gather is NOT donated (the source pool lives on; extraction
+        # is a copy), the scatter donates the destination pool; int8
+        # pools move the scale plane in the same executable so q +
+        # scale can never tear apart in flight
+        if self.kv_bits == 8:
+            self._kv_gather = jax.jit(lambda c, cs, i: (c[i], cs[i]))
+            self._kv_scatter = jax.jit(
+                lambda c, cs, i, sc, ss: (c.at[i].set(sc),
+                                          cs.at[i].set(ss)),
+                donate_argnums=(0, 1))
+        else:
+            self._kv_gather = jax.jit(lambda c, i: c[i])
+            self._kv_scatter = jax.jit(lambda c, i, sc: c.at[i].set(sc),
+                                       donate_argnums=(0,))
+        self._mig_header = {
+            "version": kv_migrate.PAYLOAD_VERSION, "engine": "mlp",
+            "block_size": block_size,
+            "max_blocks_per_seq": max_blocks_per_seq,
+            "kv_bits": self.kv_bits, "tp": 1, "hidden": hidden,
+            "dtype": str(self.cache.dtype),
+        }
 
     def kv_bytes_per_token(self) -> float:
         """HBM bytes one cached token costs (int8 pools include the
@@ -418,6 +442,62 @@ class MLPLMEngine:
             return
         self.cache = self._copy_block(self.cache, np.int32(src),
                                       np.int32(dst))
+
+    def extract_kv_blocks(self, seq_id: int) -> kv_migrate.KVBlockPayload:
+        """Export `seq_id`'s committed KV blocks as ONE device gather
+        (the disaggregated-serving handoff / KV-shipping relocation
+        export, ISSUE 17). The source pool is untouched (gather is not
+        donated) — extraction is a copy, so the caller decides when to
+        release the source sequence. The block-index vector is padded
+        to the fixed `max_blocks_per_seq` shape, so every sequence
+        length rides the same compiled executable (zero retraces)."""
+        mgr = self.manager
+        blocks = mgr.blocks_of(seq_id)
+        if not blocks:
+            raise kv_migrate.KVMigrationError(
+                f"sequence {seq_id} holds no KV blocks on this engine")
+        idx = kv_migrate.pad_block_indices(blocks, mgr.max_blocks_per_seq)
+        header = dict(self._mig_header, num_blocks=len(blocks),
+                      num_tokens=mgr.seq_len(seq_id))
+        if self.kv_bits == 8:
+            slab, sscale = self._kv_gather(self.cache, self.cache_scale,
+                                           idx)
+            return kv_migrate.KVBlockPayload(
+                header, {"cache": slab, "scale": sscale})
+        return kv_migrate.KVBlockPayload(
+            header, {"cache": self._kv_gather(self.cache, idx)})
+
+    def inject_kv_blocks(self, seq_id: int,
+                         payload: kv_migrate.KVBlockPayload) -> None:
+        """Import a migrated payload under `seq_id`: validate the header
+        (typed `KVMigrationError` BEFORE any allocation), allocate the
+        block run (the manager's typed `KVCacheExhausted`/
+        `SequenceTooLong` propagate), then scatter the slabs in one
+        donated executable. Any failure after allocation frees the
+        just-allocated blocks — a failed inject never leaks. The
+        payload's slabs are not donated, so the same payload can stream
+        to several workers (cross-replica prefix reuse)."""
+        mgr = self.manager
+        kv_migrate.check_header(payload.header, self._mig_header)
+        blocks = mgr.allocate(seq_id, payload.num_tokens)
+        try:
+            if len(blocks) != payload.num_blocks:
+                raise kv_migrate.KVMigrationError(
+                    f"payload carries {payload.num_blocks} blocks but "
+                    f"{payload.num_tokens} tokens allocate "
+                    f"{len(blocks)} here")
+            idx = kv_migrate.pad_block_indices(blocks,
+                                               mgr.max_blocks_per_seq)
+            if self.kv_bits == 8:
+                self.cache, self.cache_scale = self._kv_scatter(
+                    self.cache, self.cache_scale, idx,
+                    payload.slabs["cache"], payload.slabs["scale"])
+            else:
+                self.cache = self._kv_scatter(self.cache, idx,
+                                              payload.slabs["cache"])
+        except Exception:
+            mgr.free(seq_id)
+            raise
 
     def respawn(self) -> "MLPLMEngine":
         """Build a fresh engine with IDENTICAL weights (seed-derived) and

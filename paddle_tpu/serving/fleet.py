@@ -87,6 +87,11 @@ _UNRECOVERABLE_PREFIXES = ("engine_unrecoverable", "engine_rebuild_failed")
 # session-affinity map bound (LRU-evicted in `_note_session`): affinity
 # is advisory, so eviction only costs one least-loaded placement
 _SESSION_CAP = 65536
+# disaggregated serving roles (ISSUE 17): "prefill" replicas take fresh
+# prompts, "decode" replicas take migrated-KV sessions, "mixed" takes
+# both; role filters are preferences — an empty tier falls back to the
+# whole fleet (availability beats specialization)
+_REPLICA_ROLES = {"prefill", "decode", "mixed"}
 
 
 class ReplicaHandle:
@@ -95,10 +100,15 @@ class ReplicaHandle:
     accounting the router's placement score and fleet aggregation read."""
 
     def __init__(self, replica_id: str, frontend: ServingFrontend,
-                 incarnation: int):
+                 incarnation: int, role: str = "mixed"):
         self.replica_id = replica_id
         self.frontend = frontend
         self.incarnation = incarnation
+        # disaggregated serving (ISSUE 17): "prefill" replicas take
+        # fresh prompts and hand completed sessions off; "decode"
+        # replicas take migrated sessions; "mixed" takes both (the
+        # pre-disaggregation fleet is all-mixed)
+        self.role = role
         self.alive = True
         self.draining = False
         self.death_reason: Optional[str] = None
@@ -140,7 +150,8 @@ class ReplicaHandle:
                  "alive" if self.alive else
                  self.death_reason or "dead")
         return (f"ReplicaHandle({self.replica_id}, {state}, "
-                f"inc={self.incarnation}, tokens={self.tokens_produced})")
+                f"role={self.role}, inc={self.incarnation}, "
+                f"tokens={self.tokens_produced})")
 
 
 class FleetHandle(RequestHandle):
@@ -180,7 +191,9 @@ class FleetRouter:
                  submit_retries: int = 1,
                  kv_pressure_weight: float = 8.0,
                  parallel: bool = False,
+                 prefix_streaming: bool = True,
                  frontend_kwargs: Optional[dict] = None,
+                 roles: Optional[Sequence[str]] = None,
                  clock: Callable[[], float] = time.perf_counter,
                  wall_clock: Callable[[], float] = time.time):
         """`engine_factory` builds ONE replica's engine (called once per
@@ -201,10 +214,36 @@ class FleetRouter:
         overridden there, each replica gets `engine_factory` as its
         watchdog rebuild hook, so replica-internal restarts happen
         below the router and only *unrecoverable* collapse escalates to
-        relocation. `wall_clock` feeds membership TTLs (injectable:
-        zero-sleep reap tests); `clock` feeds latency accounting."""
+        relocation. `roles`: per-replica serving roles for disaggregated
+        prefill/decode (`"prefill"` | `"decode"` | `"mixed"`, one per
+        replica; default all-mixed — the colocated fleet). Role-aware
+        placement routes fresh prompts to prefill-capable replicas and
+        migrated KV sessions to decode-capable ones; see
+        `serving/disagg.py` for the handoff pump that moves sessions
+        between the tiers. `prefix_streaming`: when replicas run the
+        radix prefix cache (`frontend_kwargs=dict(prefix_cache=True)`),
+        an admission-time first-miss on one replica pulls the prefix KV
+        from the best-matching live peer over the migration primitive
+        (cross-replica prefix reuse) — best-effort, every failure falls
+        back to a cold prefill. Inline streams are wired only under
+        sequential stepping: with `parallel=True` the hook would reach
+        into a peer's engine from another worker thread mid-round, so
+        it is left unset. `wall_clock` feeds membership TTLs
+        (injectable: zero-sleep reap tests); `clock` feeds latency
+        accounting."""
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1: {num_replicas}")
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != num_replicas:
+                raise ValueError(
+                    f"roles must name every replica: got {len(roles)} "
+                    f"roles for num_replicas={num_replicas}")
+            bad = sorted(set(roles) - _REPLICA_ROLES)
+            if bad:
+                raise ValueError(
+                    f"unknown replica role(s) {bad}; "
+                    f"valid: {sorted(_REPLICA_ROLES)}")
         self.engine_factory = engine_factory
         self.relocation_budget = int(relocation_budget)
         self.submit_retries = int(submit_retries)
@@ -213,6 +252,7 @@ class FleetRouter:
         self.sweep_every = max(1, int(sweep_every))
         self.frontend_kwargs = dict(frontend_kwargs or {})
         self._parallel = bool(parallel)
+        self._prefix_streaming = bool(prefix_streaming) and not parallel
         self._pool = None
         self._clock = clock
         self._wall = wall_clock
@@ -231,26 +271,36 @@ class FleetRouter:
         self._sessions: Dict[str, str] = {}     # session_id -> replica_id
         self._handles: List[FleetHandle] = []   # non-terminal fleet reqs
         self._step_idx = 0
-        for _ in range(num_replicas):
-            self._spawn(engine_factory)
+        for i in range(num_replicas):
+            self._spawn(engine_factory,
+                        role=roles[i] if roles is not None else "mixed")
         self._publish_gauges()
 
     # ---- membership / replica lifecycle ----
-    def _spawn(self, factory: Callable) -> ReplicaHandle:
+    def _spawn(self, factory: Callable,
+               role: str = "mixed") -> ReplicaHandle:
         rid = f"replica-{next(self._rep_ids)}"
         kw = dict(self.frontend_kwargs)
         kw.setdefault("engine_factory", factory)
         fe = ServingFrontend(factory(), clock=self._clock, **kw)
-        rep = ReplicaHandle(rid, fe, incarnation=0)
+        rep = ReplicaHandle(rid, fe, incarnation=0, role=role)
+        if self._prefix_streaming \
+                and fe.scheduler.prefix_cache is not None:
+            fe.scheduler.prefix_stream_hook = \
+                lambda toks, _rep=rep: self._stream_prefix_to(_rep, toks)
         rep.incarnation = self.manager.register(rid, payload=rep.load())
         self._replicas.append(rep)
         return rep
 
-    def add_replica(self, engine_factory: Optional[Callable] = None) -> str:
+    def add_replica(self, engine_factory: Optional[Callable] = None,
+                    role: str = "mixed") -> str:
         """Elastic scale-out: join one fresh replica (new pod id, fresh
         incarnation) and start placing onto it immediately. Returns the
         replica id."""
-        rep = self._spawn(engine_factory or self.engine_factory)
+        if role not in _REPLICA_ROLES:
+            raise ValueError(f"unknown replica role {role!r}; "
+                             f"valid: {sorted(_REPLICA_ROLES)}")
+        rep = self._spawn(engine_factory or self.engine_factory, role=role)
         _monitor.inc("fleet.replicas_added")
         self._publish_gauges()
         return rep.replica_id
@@ -352,9 +402,22 @@ class FleetRouter:
                 * s.engine.manager.utilization())
 
     def _targets(self, session_id: Optional[str],
-                 exclude: Set[ReplicaHandle]) -> List[ReplicaHandle]:
+                 exclude: Set[ReplicaHandle],
+                 phase: Optional[str] = None) -> List[ReplicaHandle]:
+        """Ordered placement candidates. `phase` names the work being
+        placed — "prefill" (a fresh/folded prompt) prefers
+        prefill-capable replicas, "decode" (a migrated-KV session)
+        prefers decode-capable ones; mixed replicas serve both. The
+        role filter is a preference, not a fence: when the wanted tier
+        has no placeable replica (all dead/draining), the whole fleet
+        is eligible — availability beats specialization."""
         placeable = [r for r in self._replicas
                      if r.alive and not r.draining and r not in exclude]
+        if phase is not None:
+            tiered = [r for r in placeable
+                      if r.role == phase or r.role == "mixed"]
+            if tiered:
+                placeable = tiered
         placeable.sort(key=lambda r: (self._score(r),
                                       self._replicas.index(r)))
         if session_id is not None:
@@ -417,7 +480,7 @@ class FleetRouter:
         `no_replica_available`)."""
         req = fh._req
         attempts_left = self.submit_retries + 1
-        for rep in self._targets(fh.session_id, exclude):
+        for rep in self._targets(fh.session_id, exclude, phase="prefill"):
             if attempts_left <= 0:
                 break
             try:
@@ -442,6 +505,47 @@ class FleetRouter:
             # every placement attempt faulted before reaching admission
             self._terminal(fh, RequestStatus.FAILED,
                            "no_replica_available")
+        return False
+
+    def _place_session(self, fh: FleetHandle, payload,
+                       exclude: Set[ReplicaHandle]) -> bool:
+        """Place a request WITH its migrated KV
+        (`ServingFrontend.import_session`): decode-capable targets
+        first, session affinity intact. A typed migration/capacity
+        refusal (pool exhausted on that target, geometry mismatch, an
+        engine without the primitive) moves to the next candidate
+        without consuming a retry — those are per-target conditions,
+        unlike a shed. Returns True when some replica owns the session;
+        on False the request is left for the caller's re-prefill
+        fallback (non-terminal, or terminal-rejected on a structural
+        reason)."""
+        req = fh._req
+        attempts_left = self.submit_retries + 1
+        for rep in self._targets(fh.session_id, exclude, phase="decode"):
+            if attempts_left <= 0:
+                break
+            try:
+                _faults.check("fleet.submit")
+            except Exception:
+                _monitor.inc("fleet.submit_faults")
+                continue
+            if req.status.terminal:     # reset a prior shed for retry
+                req.status = RequestStatus.QUEUED
+                req.finish_reason = None
+            req.replica_id = rep.replica_id
+            try:
+                rep.frontend.import_session(req, payload)
+            except Exception:
+                _monitor.inc("fleet.kv_import_failures")
+                continue
+            attempts_left -= 1
+            if not req.status.terminal:
+                fh._replica = rep
+                self._note_session(fh.session_id, rep.replica_id)
+                return True
+            if req.finish_reason in _NO_RETRY_REASONS:
+                return False
+            _monitor.inc("fleet.retried_submits")
         return False
 
     def _note_session(self, session_id: Optional[str], replica_id: str):
@@ -475,29 +579,103 @@ class FleetRouter:
                 reason=reason)
 
     # ---- relocation (the fleet failure semantics) ----
+    def _extract_payload(self, src: ReplicaHandle, req: Request):
+        """Best-effort KV export from a still-live source replica.
+        Returns a `KVBlockPayload` or None (engine without the
+        primitive, no resident blocks, or an extraction fault) — None
+        just means the relocation re-prefills."""
+        try:
+            eng = src.frontend.scheduler.engine
+            extract = getattr(eng, "extract_kv_blocks", None)
+            if extract is None:
+                return None
+            if eng.manager.seq_blocks(req.seq_id) <= 0:
+                return None
+            return extract(req.seq_id)
+        except Exception:
+            _monitor.inc("fleet.kv_ship_failures")
+            return None
+
+    def _stream_prefix_to(self, rep: ReplicaHandle, tokens) -> None:
+        """Cross-replica prefix reuse (ISSUE 17): `rep`'s scheduler hit
+        an admission-time radix FIRST-MISS on `tokens` — pull the
+        longest full-block cached prefix from the best-matching live
+        peer over the migration primitive and publish it into `rep`'s
+        tree, so the lease that follows hits locally and the prefill is
+        skipped. Best-effort by contract: every failure is counted
+        (`fleet.prefix_stream_failures`) and swallowed — a failed
+        stream means a cold prefill, never a failed request."""
+        tgt = rep.frontend.scheduler
+        best, best_hit = None, 0
+        for peer in self.live_replicas:
+            if peer is rep:
+                continue
+            tree = peer.frontend.scheduler.prefix_cache
+            if tree is None:
+                continue
+            try:
+                _blocks, hit = tree.match_export(tokens)
+            except Exception:
+                continue
+            if hit > best_hit:
+                best, best_hit = peer, hit
+        if best is None:
+            return
+        try:
+            payload = best.frontend.scheduler.export_prefix(tokens)
+            gained = (0 if payload is None
+                      else tgt.import_prefix(tokens, payload))
+        except Exception:
+            _monitor.inc("fleet.prefix_stream_failures")
+            return
+        if gained:
+            _monitor.inc("fleet.prefix_streams")
+            _monitor.inc("fleet.prefix_stream_tokens", gained)
+            _monitor.inc("fleet.prefix_stream_bytes", payload.nbytes)
+
     def _relocate(self, fh: FleetHandle, reason: str,
                   live_source: bool) -> None:
-        """Move one request to a survivor, committed tokens intact: the
-        generated stream so far becomes part of the prompt (re-prefilled
-        on the target — token-deterministic, the preemption invariant
-        across replicas), `max_new_tokens` shrinks by what is already
+        """Move one request to a survivor, committed tokens intact.
+
+        Two paths (docs/SERVING.md "Disaggregated prefill/decode"):
+
+        - **KV shipping** (source live and reachable — drain, overload,
+          handoff fallback): the committed KV blocks are extracted from
+          the source pool BEFORE release frees them and injected into
+          the target (`import_session`), so the target decodes from the
+          next token with NO re-prefill. The generated stream, pending
+          sampled token, and sampling state ride along untouched —
+          greedy continuation is bitwise the unmoved run's.
+        - **Committed-prefix re-prefill** (dead source, or shipping
+          refused everywhere): the generated stream so far folds into
+          the prompt and the target re-prefills — token-deterministic,
+          the preemption invariant across replicas.
+
+        Both paths shrink the remaining budget by what is already
         committed, and the relocation budget bounds how often a request
         may move. `live_source` releases cleanly from a still-running
-        replica (drain); a dead source's scheduler is never touched."""
+        replica (drain); a dead source's scheduler — and pool — is
+        never touched."""
         req = fh._req
         src = fh._replica
+        payload = None
+        if live_source and src is not None and src.alive \
+                and not req.status.terminal:
+            # extract BEFORE release: release frees the source blocks
+            payload = self._extract_payload(src, req)
         if live_source and src is not None:
             src.frontend.release(req)
         carried = list(req.generated)
-        fh._prefix.extend(carried)
-        remaining = fh.max_new_total - len(fh._prefix)
+        remaining = fh.max_new_total - (len(fh._prefix) + len(carried))
         if remaining <= 0:
             # everything the caller asked for is already committed — the
             # relocation IS the finish (eos'd requests are terminal
             # before ever reaching here)
+            fh._prefix.extend(carried)
             self._terminal(fh, RequestStatus.FINISHED, "max_new_tokens")
             return
         if req.num_relocations >= self.relocation_budget:
+            fh._prefix.extend(carried)
             self._terminal(fh, RequestStatus.FAILED,
                            "relocation_budget_exhausted")
             return
@@ -509,18 +687,36 @@ class FleetRouter:
                 req.req_id, "relocated", self._clock(),
                 from_replica=src.replica_id if src else None,
                 reason=reason, tokens_carried=len(carried),
-                relocations=req.num_relocations)
-        if carried:
-            req.prompt = np.concatenate(
-                [req.prompt,
-                 np.asarray(carried, np.int32)]).astype(np.int32)
-        req.generated = []
-        req._last = None
-        req.sampling.max_new_tokens = remaining
-        req.status = RequestStatus.QUEUED
-        req.finish_reason = None
+                relocations=req.num_relocations,
+                shipped_kv=payload is not None)
         t_submit0 = req.t_submit
-        placed = self._place_request(fh, exclude={src} if src else set())
+        placed = False
+        if payload is not None:
+            # KV-shipping path: generated/_last/sampling stay in place —
+            # the target picks up mid-stream from the migrated blocks
+            req.status = RequestStatus.QUEUED
+            req.finish_reason = None
+            placed = self._place_session(
+                fh, payload, exclude={src} if src else set())
+            if placed:
+                _monitor.inc("fleet.relocations_shipped")
+                _monitor.inc("fleet.shipped_kv_bytes",
+                             int(payload.nbytes))
+        if not placed:
+            # re-prefill fallback (and the pre-shipping default): fold
+            # committed tokens into the prompt and resubmit
+            fh._prefix.extend(carried)
+            if carried:
+                req.prompt = np.concatenate(
+                    [req.prompt,
+                     np.asarray(carried, np.int32)]).astype(np.int32)
+            req.generated = []
+            req._last = None
+            req.sampling.max_new_tokens = remaining
+            req.status = RequestStatus.QUEUED
+            req.finish_reason = None
+            placed = self._place_request(
+                fh, exclude={src} if src else set())
         if not placed and live_source and src is not None and src.alive:
             # drain fallback: no survivor took it (none placeable, or
             # every one shed) — finish in place on the still-live
@@ -722,6 +918,7 @@ class FleetRouter:
             "dead": {r.replica_id: r.death_reason
                      for r in self._replicas
                      if not r.alive and r.death_reason != "drained"},
+            "roles": {r.replica_id: r.role for r in self._replicas},
             "aggregate": mesh["sum"],
             "straggler_replica":
                 None if mesh.get("straggler_host") is None

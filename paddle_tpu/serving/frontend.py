@@ -209,6 +209,17 @@ class ServingFrontend:
         exception)."""
         return self.scheduler.submit(req, now=self._clock())
 
+    def import_session(self, req: Request, payload) -> Request:
+        """Admit an existing `Request` whose context KV arrives as a
+        migrated `KVBlockPayload` instead of through prefill — the
+        disaggregated handoff / KV-shipping relocation entry
+        (`Scheduler.import_session`, ISSUE 17). Load conditions come
+        back as a terminal status on the request; migration mismatches
+        and pool exhaustion raise TYPED so the router can fall back to
+        a committed-prefix re-prefill."""
+        return self.scheduler.import_session(req, payload,
+                                             now=self._clock())
+
     # ---- driving ----
     def step(self) -> int:
         """Advance one scheduling round; returns tokens produced."""

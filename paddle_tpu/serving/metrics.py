@@ -172,6 +172,18 @@ class ServingMetrics:
             monitor.set_gauge("serving.prefix_cache.hit_rate_pct",
                               round(hits / (hits + miss) * 100.0, 1))
 
+    # ---- disaggregated prefill/decode (ISSUE 17) ----
+    def on_handoff(self, nbytes: int, wall_s: float):
+        """One prefill→decode session handoff landed: `nbytes` of KV
+        payload migrated (slabs + scale planes), `wall_s` extract→inject
+        wall time. Counters size the interconnect a real deployment
+        needs; the histogram is the handoff-latency SLO surface
+        (docs/SERVING.md "Disaggregated prefill/decode")."""
+        monitor.inc("serving.handoff.count")
+        monitor.inc("serving.handoff.bytes", int(nbytes))
+        monitor.inc("serving.handoff.wall_ms", wall_s * 1e3)
+        monitor.observe("serving.handoff.latency_seconds", wall_s)
+
     # ---- quantized serving ----
     def on_quant(self, info: dict):
         """Publish the engine's quantization mode (serving/quant.py

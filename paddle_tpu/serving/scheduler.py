@@ -83,6 +83,7 @@ from ..framework import monitor as _monitor
 from ..profiler import RecordEvent
 from ..framework.retry import Budget, retry_call
 from ..inference.cache import KVCacheExhausted, SequenceTooLong
+from ..inference.kv_migrate import KVMigrationError
 from ..inference.prefix_cache import RadixPrefixCache
 from ..ops.sampling import sample_tokens
 from ..resilience import faults as _faults
@@ -172,6 +173,11 @@ class Request:
         self._prefix_hit_tokens = 0           # cached tokens this admission
         self._chunks = 0
         self._t_admit: Optional[float] = None
+        # context KV arrived as a migrated payload (`import_session`,
+        # ISSUE 17): admission skips the lease/prefill for the covered
+        # context; cleared at admission, and any queue exit before then
+        # frees the resident blocks (`_drop_resident_kv`)
+        self._kv_resident = False
 
     @property
     def prefilling(self) -> bool:
@@ -330,6 +336,13 @@ class Scheduler:
         # What one sequence can ever hold: pool minus the guard (and minus
         # blocks other users of a shared engine already lease).
         self._usable_blocks = min(mgr.free_blocks, mgr.max_blocks_per_seq)
+        # cross-replica prefix streaming (ISSUE 17): `_mig_seq` mints
+        # transient sequence ids for the export/import lease (negative,
+        # far below the pad-guard probe range); the hook — set by a
+        # fleet router — is asked for a peer's cached copy on an
+        # admission-time radix first-miss
+        self._mig_seq = -(1 << 30)
+        self.prefix_stream_hook: Optional[Callable] = None
         # radix prefix cache: built on THIS manager (and rebuilt with a
         # fresh one after a watchdog engine swap — the old tree's KV
         # died with the old device state); the engine's block-copy hook
@@ -412,6 +425,79 @@ class Scheduler:
         self._queue_push(req)
         return req
 
+    def import_session(self, req: Request, payload,
+                       now: Optional[float] = None) -> Request:
+        """Admit a request whose context KV arrives as a migrated
+        `KVBlockPayload` (`inference/kv_migrate.py`) instead of through
+        chunked prefill — the disaggregated-serving handoff and the
+        KV-shipping relocation entry (ISSUE 17).
+
+        Load conditions come back as terminal statuses exactly like
+        `submit` (broken scheduler, empty prompt, over-long context,
+        full queue — all checked BEFORE the pool is touched, so a
+        rejection never leaks blocks). Migration problems raise TYPED:
+        `KVMigrationError` (geometry/kv_bits/version mismatch, or an
+        engine without the primitive) and the manager's
+        `KVCacheExhausted`/`SequenceTooLong` from the inject's allocate
+        — the router catches these and falls back to a committed-prefix
+        re-prefill. On success the blocks sit resident under
+        `req.seq_id`; `_admit` skips the lease/prefill for the covered
+        context, so the pending `_last` token (when present) decodes on
+        the importing replica's very next round — the decode worker
+        owns the stream from token 1. Overload shedding is deliberately
+        skipped: an import carries already-spent prefill work, and
+        turning it away would discard it (capacity pressure still
+        rejects through the queue/pool checks)."""
+        now = self._clock() if now is None else now
+        if req.t_submit is None:
+            req.t_submit = now
+        self.metrics.on_submit()
+        if _obs.enabled():
+            self._obs_req(req, "queued", t0=now, imported_kv=True,
+                          prompt_tokens=int(len(req.prompt)),
+                          max_new_tokens=req.sampling.max_new_tokens)
+        if self._broken is not None:
+            return self._reject(req, self._broken)
+        if len(req.prompt) == 0:
+            return self._reject(req, "empty_prompt")
+        mgr = self.engine.manager
+        if mgr.blocks_needed(int(payload.num_tokens) + 1) \
+                > self._usable_blocks:
+            return self._reject(req, "prompt_too_long")
+        if len(self.waiting) >= self.max_queue:
+            return self._reject(req, "queue_full")
+        inject = getattr(self.engine, "inject_kv_blocks", None)
+        if inject is None:
+            raise KVMigrationError(
+                f"{type(self.engine).__name__} has no inject_kv_blocks "
+                "— this engine cannot accept migrated KV")
+        ctx = req.context_tokens()
+        if int(payload.num_tokens) != len(ctx):
+            raise KVMigrationError(
+                f"payload carries KV for {payload.num_tokens} tokens "
+                f"but the request's committed context is {len(ctx)}")
+        inject(req.seq_id, payload)     # typed errors propagate; a
+        req._kv_resident = True         # failed inject leaves no blocks
+        req.status = RequestStatus.QUEUED
+        req.finish_reason = None
+        self._queue_push(req)
+        return req
+
+    def _drop_resident_kv(self, req: Request) -> None:
+        """Free KV imported via `import_session` for a request leaving
+        the WAITING queue (deadline, cancel, release, fail-all) before
+        admission claimed it — the in-slot paths free through the
+        normal `_finish`/`release` branches. Idempotent; never raises
+        into a terminal transition."""
+        if not req._kv_resident:
+            return
+        req._kv_resident = False
+        try:
+            if self.engine.manager.seq_blocks(req.seq_id) > 0:
+                self.engine.manager.free(req.seq_id)
+        except Exception:
+            pass
+
     def _overload_for(self, tenant: str) -> OverloadController:
         """The overload controller for `tenant`: the shared base one
         without an SLO config; with one, a per-tenant controller whose
@@ -456,6 +542,77 @@ class Scheduler:
     def prefix_cache(self) -> Optional[RadixPrefixCache]:
         return self._prefix_tree
 
+    # ---- cross-replica prefix streaming (ISSUE 17) ----
+    def _mig_seq_id(self) -> int:
+        """A fresh transient sequence id for a prefix-stream lease —
+        negative and far below the pad-guard probe range, so it cannot
+        collide with request ids (non-negative) or another scheduler's
+        guard on a shared engine."""
+        mgr = self.engine.manager
+        while True:
+            self._mig_seq -= 1
+            if mgr.seq_blocks(self._mig_seq) == 0:
+                return self._mig_seq
+
+    def export_prefix(self, tokens):
+        """Export the radix-cached KV for the longest FULL-block cached
+        prefix of `tokens` as a migration payload
+        (`inference/kv_migrate.py`) — the sender side of cross-replica
+        prefix reuse. The gather rides a transient lease (adopt →
+        extract → free), so the tree's pins and every concurrent
+        request are untouched and extraction stays a copy. Returns None
+        when there is nothing to ship: cache off, engine without the
+        primitive, or a hit shorter than one block."""
+        tree = self._prefix_tree
+        extract = getattr(self.engine, "extract_kv_blocks", None)
+        if tree is None or extract is None:
+            return None
+        blocks, hit = tree.match_export(tokens)
+        if not blocks:
+            return None
+        mgr = self.engine.manager
+        tmp = self._mig_seq_id()
+        mgr.adopt(tmp, blocks, hit)
+        try:
+            return extract(tmp)
+        finally:
+            mgr.free(tmp)
+
+    def import_prefix(self, tokens, payload) -> int:
+        """Publish a streamed prefix payload (a peer's `export_prefix`)
+        into THIS replica's radix tree: inject under a transient
+        sequence, publish the full blocks, release the lease — the
+        tree's pins keep the KV alive for future leases, and blocks
+        whose content the local tree already indexes fall straight back
+        to the pool (existing nodes win ties). Returns cached tokens
+        gained; 0 when the local tree already covers the payload, the
+        pool has no room (a stream must not pressure a loaded pool), or
+        the cache/primitive is off. Typed migration errors propagate —
+        the fleet caller counts and swallows them (a failed stream just
+        means a cold prefill, never a failed request)."""
+        tree = self._prefix_tree
+        inject = getattr(self.engine, "inject_kv_blocks", None)
+        if tree is None or inject is None:
+            return 0
+        toks = np.asarray(tokens).reshape(-1).tolist()
+        n = int(payload.num_tokens)
+        if n < 1 or len(toks) < n:
+            return 0
+        _blocks, local = tree.match_export(toks)
+        if local >= n:
+            return 0
+        mgr = self.engine.manager
+        if int(payload.num_blocks) > min(mgr.free_blocks,
+                                         self._usable_blocks):
+            return 0
+        tmp = self._mig_seq_id()
+        inject(tmp, payload)
+        try:
+            added = tree.publish(tmp, toks[:n])
+        finally:
+            mgr.free(tmp)
+        return n if added else 0
+
     def _reject(self, req: Request, reason: str) -> Request:
         req.status = RequestStatus.REJECTED
         req.finish_reason = reason
@@ -499,6 +656,7 @@ class Scheduler:
             return False
         if req in self.waiting:
             self._queue_remove(req)
+            self._drop_resident_kv(req)
             req.status = RequestStatus.PREEMPTED
             return True
         for i, r in enumerate(self.slots):
@@ -978,9 +1136,15 @@ class Scheduler:
                 max(0, mgr.blocks_needed(len(r._prefill_ctx))
                     - mgr.seq_blocks(r.seq_id))
                 for r in self.slots if r is not None and r.prefilling)
+            # imported-KV admission (`import_session`, ISSUE 17): the
+            # context blocks are already leased under seq_id, so the
+            # request needs NO new capacity and no radix lease
+            resident = req._kv_resident and mgr.seq_blocks(req.seq_id) > 0
             hit_blocks = (self._prefix_tree.match_blocks(ctx)
-                          if self._prefix_tree is not None else 0)
-            need = mgr.blocks_needed(len(ctx)) - hit_blocks
+                          if self._prefix_tree is not None
+                          and not resident else 0)
+            need = 0 if resident \
+                else mgr.blocks_needed(len(ctx)) - hit_blocks
             headroom = mgr.free_blocks + mgr.reclaimable_blocks() - debt
             if need > headroom:
                 break                  # blocks return as runners finish
@@ -995,18 +1159,46 @@ class Scheduler:
                                                     "kv_reserve")
                     continue
             hit = 0
-            try:
-                if self._prefix_tree is not None:
-                    hit = self._prefix_tree.lease(req.seq_id, ctx)
-                if hit == 0:
-                    mgr.allocate(req.seq_id, 0)
-            except (KVCacheExhausted, SequenceTooLong):
-                break
-            except Exception:          # injected/corrupt cache state
-                self._queue_remove(req)
-                self._isolated(req, "engine_fault:cache", "cache",
-                               in_slot=False)
-                continue
+            if resident:
+                # the migrated KV covers the committed context; the
+                # chunk cursor starts past it. Without a pending `_last`
+                # token the FINAL context token re-enters as a one-token
+                # chunk so the first sample happens here — trim keeps
+                # manager length == attended KV, and the position-
+                # indexed rewrite is idempotent (same content, same
+                # slot). With `_last` pending the cursor covers the
+                # whole context and the token decodes next round — the
+                # importing replica owns the stream immediately.
+                req._kv_resident = False
+                target = len(ctx) if req._last is not None \
+                    else max(len(ctx) - 1, 0)
+                if mgr.seq_len(req.seq_id) > target:
+                    mgr.trim(req.seq_id, target)
+                hit = mgr.seq_len(req.seq_id)
+            else:
+                try:
+                    if self._prefix_tree is not None:
+                        if self.prefix_stream_hook is not None \
+                                and self._prefix_tree.match_tokens(
+                                    ctx) == 0:
+                            # first miss: ask the router for a peer's
+                            # cached copy before paying a cold prefill
+                            # (cross-replica prefix reuse); the hook
+                            # never raises into admission
+                            try:
+                                self.prefix_stream_hook(ctx)
+                            except Exception:
+                                pass
+                        hit = self._prefix_tree.lease(req.seq_id, ctx)
+                    if hit == 0:
+                        mgr.allocate(req.seq_id, 0)
+                except (KVCacheExhausted, SequenceTooLong):
+                    break
+                except Exception:      # injected/corrupt cache state
+                    self._queue_remove(req)
+                    self._isolated(req, "engine_fault:cache", "cache",
+                                   in_slot=False)
+                    continue
             self._queue_remove(req)
             slot = self.slots.index(None)
             # snapshot the prefill target HERE: for a preempted
@@ -1018,7 +1210,7 @@ class Scheduler:
             # one token: TTFT ≈ one decode step).
             req._prefill_ctx = ctx
             req._prefill_pos = hit
-            req._prefix_hit_tokens = hit
+            req._prefix_hit_tokens = 0 if resident else hit
             req._chunks = 0
             req._t_admit = self._clock()
             req.status = RequestStatus.RUNNING
@@ -1026,7 +1218,9 @@ class Scheduler:
             self.slots[slot] = req
             admitted += 1
             self._charge_admission(req.tenant)
-            if self._prefix_tree is not None:
+            if self._prefix_tree is not None and not resident:
+                # a resident cursor is migrated KV, not a radix hit —
+                # keep the prefix-cache hit accounting honest
                 self.metrics.on_prefix_lease(hit)
             if _obs.enabled():
                 self._obs_req(req, "admitted", t0=req._t_admit, slot=slot,
@@ -1616,6 +1810,10 @@ class Scheduler:
                 # engine fault) — never publish it into the shared tree
                 self._publish_prefix(req)
             self.engine.manager.free(req.seq_id)
+        else:
+            # a WAITING request may hold imported KV (`import_session`)
+            # that no slot path will ever free
+            self._drop_resident_kv(req)
         self._release_spec(req)
         req.status = status
         req.finish_reason = reason

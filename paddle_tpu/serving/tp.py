@@ -59,6 +59,7 @@ import numpy as np
 from .. import observability as _obs
 from ..distributed.process_mesh import ProcessMesh
 from ..distributed.tp_overlap import TPInfo
+from ..inference import kv_migrate
 from ..inference.cache import BlockCacheManager
 from ..observability import comms
 
@@ -433,6 +434,49 @@ class ShardedEngine:
             out_specs=(vspec,) + poolspec,
             check_rep=False), donate_argnums=donate)
         self._step_label = f"serving.ragged_step_tp{tp}"
+        # KV migration (inference/kv_migrate.py, ISSUE 17): the gather/
+        # scatter index the LOGICAL block axis, which is unsharded in
+        # both layouts — the compiled programs move each chip's slice
+        # locally with ZERO collectives, and the slabs stay sharded
+        # end-to-end (per-shard export; the header's `tp` pins that a
+        # payload only injects into an identically-partitioned engine).
+        # Gather NOT donated (the source pool lives on); scatter
+        # donates every destination pool.
+        npools = len(self._pools)
+        if kind == "llama":
+            self._kv_gather = jax.jit(
+                lambda *a: tuple(p[:, a[-1]] for p in a[:-1]))
+            self._kv_scatter = jax.jit(
+                lambda *a: tuple(
+                    p.at[:, a[npools]].set(s)
+                    for p, s in zip(a[:npools], a[npools + 1:])),
+                donate_argnums=tuple(range(npools)))
+            g0 = base._kv_geom
+            self._mig_header = {
+                "version": kv_migrate.PAYLOAD_VERSION, "engine": "llama",
+                "block_size": base.block_size,
+                "max_blocks_per_seq": self.manager.max_blocks_per_seq,
+                "kv_bits": self.kv_bits, "tp": tp,
+                "num_layers": g0["num_layers"],
+                "kv_heads": g0["kv_heads"], "head_dim": g0["head_dim"],
+                "dtype": str(self._pools[0].dtype),
+            }
+        else:
+            self._kv_gather = jax.jit(
+                lambda *a: tuple(p[a[-1]] for p in a[:-1]))
+            self._kv_scatter = jax.jit(
+                lambda *a: tuple(
+                    p.at[a[npools]].set(s)
+                    for p, s in zip(a[:npools], a[npools + 1:])),
+                donate_argnums=tuple(range(npools)))
+            self._mig_header = {
+                "version": kv_migrate.PAYLOAD_VERSION, "engine": "mlp",
+                "block_size": base.block_size,
+                "max_blocks_per_seq": self.manager.max_blocks_per_seq,
+                "kv_bits": self.kv_bits, "tp": tp,
+                "hidden": int(base.params["embed"].shape[1]),
+                "dtype": str(self._pools[0].dtype),
+            }
 
     # ---- observability surface ----
     def tp_summary(self) -> dict:
@@ -519,6 +563,51 @@ class ShardedEngine:
         untouched) — radix/refcount semantics identical to single-chip."""
         self._pools = list(self._copy(*self._pools, np.int32(src),
                                       np.int32(dst)))
+
+    def extract_kv_blocks(self, seq_id: int) -> kv_migrate.KVBlockPayload:
+        """Export `seq_id`'s blocks from every pool plane in ONE device
+        gather; the slabs stay TP-sharded (each chip contributes its
+        head/feature slice — per-shard export) and the header's `tp`
+        pins the partitioning, so a payload only ever injects into an
+        identically-sharded engine. Source pools untouched."""
+        mgr = self.manager
+        blocks = mgr.blocks_of(seq_id)
+        if not blocks:
+            raise kv_migrate.KVMigrationError(
+                f"sequence {seq_id} holds no KV blocks on this engine")
+        idx = kv_migrate.pad_block_indices(blocks, mgr.max_blocks_per_seq)
+        header = dict(self._mig_header, num_blocks=len(blocks),
+                      num_tokens=mgr.seq_len(seq_id))
+        slabs = self._kv_gather(*self._pools, idx)
+        return kv_migrate.KVBlockPayload(
+            header, {f"p{i}": s for i, s in enumerate(slabs)})
+
+    def inject_kv_blocks(self, seq_id: int,
+                         payload: kv_migrate.KVBlockPayload) -> None:
+        """Import a migrated payload under `seq_id`: typed header
+        validation (including the `tp` degree) BEFORE any allocation,
+        typed capacity errors from `allocate`, one donated scatter per
+        call; post-allocation failure frees the blocks. The jit
+        re-establishes each slab's sharding, so source and target pools
+        stay partition-identical without host round-trips."""
+        mgr = self.manager
+        kv_migrate.check_header(payload.header, self._mig_header)
+        blocks = mgr.allocate(seq_id, payload.num_tokens)
+        try:
+            if len(blocks) != payload.num_blocks:
+                raise kv_migrate.KVMigrationError(
+                    f"payload carries {payload.num_blocks} blocks but "
+                    f"{payload.num_tokens} tokens allocate "
+                    f"{len(blocks)} here")
+            idx = kv_migrate.pad_block_indices(blocks,
+                                               mgr.max_blocks_per_seq)
+            slabs = [payload.slabs[f"p{i}"]
+                     for i in range(len(self._pools))]
+            self._pools = list(self._kv_scatter(*self._pools, idx,
+                                                *slabs))
+        except Exception:
+            mgr.free(seq_id)
+            raise
 
     # ---- legacy single-chip entries ----
     def _no_legacy(self, entry: str):

@@ -61,6 +61,7 @@ _METRIC_TO_SCENARIO = {
     "serving_throughput_spec": "serving_spec",
     "dryrun_multichip_comms": "dryrun_multichip",
     "serving_fleet_tok_s": "serving_fleet",
+    "serving_disagg_tok_s": "serving_disagg",
     "serving_shared_prefix_tok_s": "serving_shared_prefix",
     "train_elastic_recovery_ms": "train_elastic",
 }

@@ -32,6 +32,14 @@ run's (committed-prefix parity: zero lost, zero duplicated tokens),
 relocations within the per-request budget, and `kv_leaked_blocks()==0`
 on every SURVIVOR (the dead replica's pool died with it).
 
+Disaggregated pass (`fleet.handoff`, ISSUE 17): the same burst on a
+2-prefill + 2-decode `DisaggRouter`; first an unkilled run proving
+handed-off streams are bitwise the colocated fleet's, then the armed
+``fleet.handoff`` flag kills a PREFILL worker mid-handoff — every
+request still terminal (zero lost), zero leaked blocks on every
+survivor, and every finished stream still bitwise the colocated
+reference's.
+
 All injection is counted-call arithmetic (`resilience.faults`): no
 clocks, no randomness, no sleeps. Tier-1-safe: MLP engine, < 15 s CPU.
 
@@ -325,6 +333,116 @@ def fleet_chaos(reference_tokens):
         router.close()
 
 
+def disagg_run(kill_handoff_at=None, relocation_budget=2):
+    """Serve the same deterministic burst on a 2-prefill + 2-decode
+    disaggregated fleet (`serving/disagg.py`), optionally arming
+    ``fleet.handoff`` with ``action="flag"`` so the k-th handoff kills
+    its PREFILL worker mid-migration. Returns (router, handles)."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (DisaggRouter, ServingMetrics,
+                                    WatchdogConfig)
+
+    ServingMetrics.reset_monitor()
+    monitor.reset_prefix("fleet.")
+    router = DisaggRouter(
+        make_engine, num_prefill=2, num_decode=2,
+        relocation_budget=relocation_budget,
+        frontend_kwargs=dict(watchdog=WatchdogConfig(
+            step_retries=2, max_restarts=MAX_RESTARTS)))
+    if kill_handoff_at is not None:
+        faults.inject("fleet.handoff", after_n=kill_handoff_at, times=1,
+                      action="flag")
+    handles = []
+    arrivals = fleet_trace()
+    i = 0
+    step = 0
+    while i < len(arrivals) or not router.idle:
+        while i < len(arrivals) and arrivals[i][0] <= step:
+            handles.append(router.submit(arrivals[i][1],
+                                         max_new_tokens=6))
+            i += 1
+        router.step()
+        step += 1
+        assert step < 4000, "disagg burst never drained"
+    faults.clear()
+    return router, handles
+
+
+def disagg_chaos(reference_tokens):
+    """Disaggregated pass (ISSUE 17): kill a prefill worker MID-HANDOFF
+    (the armed ``fleet.handoff`` flag fires between extraction and the
+    decode-tier import). Contract: every request terminal (zero lost),
+    zero leaked blocks on every survivor, and every finished greedy
+    stream — handed-off, fold-relocated, and untouched alike — bitwise
+    equal to the unkilled colocated run's."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.serving import RequestStatus
+
+    # unkilled disagg reference first: handoffs happen, streams must
+    # already match the colocated fleet reference bitwise
+    router, handles = disagg_run()
+    try:
+        assert all(h.status is RequestStatus.FINISHED for h in handles)
+        mismatch = [i for i, (h, ref) in
+                    enumerate(zip(handles, reference_tokens))
+                    if h.tokens != ref]
+        assert not mismatch, \
+            f"disagg-vs-colocated parity broke at {mismatch}"
+        handoffs = monitor.get("fleet.handoffs")
+        assert handoffs > 0, "no handoffs — the tiers never streamed"
+        assert monitor.get("serving.handoff.count") == handoffs
+        assert monitor.get("serving.handoff.bytes") > 0
+    finally:
+        router.close()
+
+    router, handles = disagg_run(kill_handoff_at=2)
+    try:
+        dead = [r for r in router.replicas if not r.alive]
+        survivors = [r for r in router.replicas if r.alive]
+        assert len(dead) == 1 and dead[0].role == "prefill" \
+            and dead[0].death_reason == "handoff_chaos_kill", \
+            f"expected one prefill worker dead mid-handoff, got {dead}"
+        # 1. nothing lost: every request terminal
+        non_terminal = [h.request_id for h in handles if not h.finished]
+        assert not non_terminal, f"non-terminal after kill {non_terminal}"
+        # 2. greedy parity vs the unkilled colocated run for EVERY
+        # finished request — handed-off and fold-relocated alike
+        mismatch = [i for i, (h, ref) in
+                    enumerate(zip(handles, reference_tokens))
+                    if h.status is RequestStatus.FINISHED
+                    and h.tokens != ref]
+        assert not mismatch, f"handoff-kill parity broke at {mismatch}"
+        relocated = [h for h in handles if h.num_relocations > 0]
+        assert relocated, "the mid-handoff kill relocated nothing — " \
+            "tune kill_handoff_at"
+        # 3. zero leaked KV blocks on every survivor (the dead prefill
+        # worker's pool died with it; targets never allocated for the
+        # interrupted handoff)
+        for rep in survivors:
+            leaked = rep.scheduler.kv_leaked_blocks()
+            assert leaked == 0, f"{rep.replica_id}: {leaked} leaked"
+        report = {
+            "scenario": "fleet.handoff:prefill_kill",
+            "requests": len(handles),
+            "finished": sum(h.status is RequestStatus.FINISHED
+                            for h in handles),
+            "killed": dead[0].replica_id,
+            "killed_role": dead[0].role,
+            "handoffs": monitor.get("fleet.handoffs"),
+            "handoff_fallbacks": monitor.get("fleet.handoff_fallbacks"),
+            "relocated": len(relocated),
+            "relocations_shipped":
+                monitor.get("fleet.relocations_shipped"),
+            "survivor_parity": True,
+            "leaked_blocks": 0,
+        }
+        print(json.dumps(report))
+        return report
+    finally:
+        router.close()
+
+
 def prefix_trace():
     """Shared-prefix mix: 6 of 8 prompts carry one 12-token system
     prefix (3 full blocks at block_size 4) plus a unique suffix — once
@@ -511,6 +629,10 @@ def main():
         ref_router.close()
     reports.append(fleet_chaos(fleet_reference))
 
+    # disaggregated pass (ISSUE 17): prefill worker killed mid-handoff
+    faults.clear()
+    reports.append(disagg_chaos(fleet_reference))
+
     print(json.dumps({
         "ok": True,
         "scenarios": len(reports),
@@ -521,7 +643,9 @@ def main():
                     "int8 KV pool: cache fault -> zero leaks, quantized "
                     "byte geometry in telemetry, "
                     "fleet: replica kill -> relocation parity, "
-                    "relocations <= budget, survivors leak-free",
+                    "relocations <= budget, survivors leak-free, "
+                    "disagg: prefill kill mid-handoff -> zero lost, "
+                    "zero leaked, handed-off streams bitwise colocated",
     }))
 
 
