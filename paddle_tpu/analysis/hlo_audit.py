@@ -294,6 +294,35 @@ def _exe_ragged_decode_quant():
                          q_lens, kv_lens, tables)
 
 
+def _exe_ragged_decode_lora():
+    """The MULTI-LoRA serving decode program (ISSUE 18): the MLP audit
+    engine through `serving.lora.attach_adapters` with one resident
+    adapter, at the same packed shapes as `ragged_decode`. The per-lane
+    adapter ids enter as one [B] int32 argument riding the ragged
+    metadata (data, not shape), the batched A/B gathers and the two thin
+    low-rank einsums are device-side by construction — so the compiled
+    form must stay exactly as host-transfer-free and collective-free as
+    the base decode program across ANY adapter mix."""
+    import numpy as np
+
+    from ..serving.engine import MLPLMEngine
+    from ..serving.lora import attach_adapters, random_adapter
+
+    eng = attach_adapters(
+        MLPLMEngine(vocab_size=64, hidden=16, max_batch_size=4,
+                    num_blocks=16, block_size=4, max_blocks_per_seq=4),
+        pool_slots=2, rank_buckets=(2, 4))
+    eng.adapter_pool.register("audit", random_adapter(eng, rank=4))
+    eng.adapter_pool.pin("audit")
+    B, T = 4, 4 + 8                       # max_batch + chunk budget
+    tokens = np.zeros((T,), np.int32)
+    q_lens = np.array([1, 1, 2, 0], np.int32)
+    kv_lens = np.array([3, 1, 2, 0], np.int32)
+    tables = np.zeros((B, 4), np.int32)
+    fn, lead = eng.cost_card_args("ragged")
+    return fn, (*lead, tokens, q_lens, kv_lens, tables)
+
+
 def _exe_ragged_decode_tp():
     """The TP-SHARDED serving decode program (ISSUE 16): the MLP audit
     engine through `serving.tp.shard_engine(tp=2, overlap=True)` at the
@@ -437,6 +466,7 @@ def _exe_kv_inject():
 EXECUTABLES = {
     "ragged_decode": _exe_ragged_decode,
     "ragged_decode_quant": _exe_ragged_decode_quant,
+    "ragged_decode_lora": _exe_ragged_decode_lora,
     "ragged_decode_tp": _exe_ragged_decode_tp,
     "quant_matmul": _exe_quant_matmul,
     "verify": _exe_verify,

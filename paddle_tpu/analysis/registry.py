@@ -63,6 +63,15 @@ HOT_PATHS = {
     ("serving/tp.py", "ShardedEngine.verify_step"),
     ("serving/tp.py", "ShardedEngine._dispatch"),
     ("serving/tp.py", "ShardedEngine.copy_kv_block"),
+    # the multi-LoRA dispatch surfaces (ISSUE 18): every token of every
+    # multi-adapter serving run crosses these; the per-lane adapter-slot
+    # install runs before EVERY ragged/verify round — stray per-call
+    # imports or host conversions here tax every tenant at once
+    ("serving/lora.py", "LoRAEngine.ragged_step"),
+    ("serving/lora.py", "LoRAEngine.verify_step"),
+    ("serving/lora.py", "LoRAEngine.copy_kv_block"),
+    ("serving/lora.py", "LoRAEngine.set_lane_adapters"),
+    ("serving/scheduler.py", "Scheduler._install_lane_adapters"),
     # the elastic supervisor's per-step heartbeat: one membership-store
     # write per train step — a per-call device_put/import/extra blocking
     # call here lands on EVERY step of every supervised training run

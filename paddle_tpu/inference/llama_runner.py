@@ -103,14 +103,20 @@ def _quantize_stacked(params, algo: str):
 
 
 def _mm(x, w):
-    """x [..., K] @ layer weight: dense [K, N] array (einsum) or
+    """x [..., K] @ layer weight: dense [K, N] array (einsum),
     weight-only-quantized {"q": [N, K], "s": [N]} / int4-packed
     {"q4": [N, K//2], "s": [N]} via the shared `nn.quant.dequant_matmul`
-    (Pallas dequant-in-kernel gemm on aligned TPU shapes)."""
+    (Pallas dequant-in-kernel gemm on aligned TPU shapes), or a
+    multi-LoRA epilogue dict {"w", "la", "lb", "ids"} that recursively
+    wraps any of the former (`serving/lora.py`)."""
     import jax.numpy as jnp
 
     if not isinstance(w, dict):
         return jnp.einsum("...k,kn->...n", x, w.astype(x.dtype))
+    if "la" in w:
+        from ..serving.lora import lora_mm
+
+        return lora_mm(x, w, _mm)
     from ..nn.quant import dequant_matmul
 
     if "q4" in w:

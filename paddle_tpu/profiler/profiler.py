@@ -557,6 +557,18 @@ class Profiler:
                 f"prefill→decode, {h('bytes')} KV payload bytes, "
                 f"{round(h('wall_ms') / max(1, h('count')), 3)} ms/handoff "
                 f"mean extract→inject wall")
+        # Multi-LoRA block: rendered once an adapter pool is bound
+        # (serving/lora.py; docs/SERVING.md "Multi-LoRA serving") — the
+        # switch_retraces figure is the one that must stay 0 in steady
+        # state across any adapter mix
+        lo = lambda k: snap.get(f"serving.lora.{k}", 0)  # noqa: E731
+        if lo("pool_slots"):
+            lines.append(
+                f"  LoRA: {lo('resident_adapters')}/{lo('pool_slots')} "
+                f"slots resident ({lo('registered_adapters')} registered, "
+                f"rank<= {lo('rank_max')}), {lo('miss_loads')} miss loads, "
+                f"{lo('evictions')} evictions, "
+                f"switch retraces {lo('switch_retraces')}")
         # Prefix cache block: only rendered once the radix cache saw an
         # admission (hits + misses > 0) — docs/SERVING.md "Prefix
         # caching & multi-tenant SLOs"

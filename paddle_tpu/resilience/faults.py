@@ -31,7 +31,12 @@ Serving sites (`serving/scheduler.py` via :func:`check_flag`, and
 ``serve.verify`` (per engine dispatch; ``action="flag"`` asks the
 scheduler to poison one lane's logits with NaN instead of raising),
 ``serve.sample`` (per fused-sampler call), ``serve.cache`` (per
-`BlockCacheManager.allocate`/`append_tokens`). An ``exc`` that is an
+`BlockCacheManager.allocate`/`append_tokens`), ``serve.adapter`` (per
+`AdapterPool.lease` MISS — the adapter load/evict path, checked BEFORE
+any pool mutation so an injected fault can never tear the
+registry/slot/refcount books; the faulted admission fails typed
+``engine_fault:adapter`` while resident-adapter admissions ride
+through). An ``exc`` that is an
 `serving.EngineStepError` with ``seq_ids`` drives the targeted
 lane-isolation path; the default `InjectedIOError` drives the
 transient-retry path. See docs/SERVING.md "Failure semantics".

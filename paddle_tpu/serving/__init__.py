@@ -43,6 +43,11 @@ Components:
   split into role-specialized tiers, with prefill-complete sessions
   streamed to the decode tier as migrated KV-block payloads
   (`inference/kv_migrate.py`) instead of re-prefilled.
+- multi-LoRA serving (lora.py): `attach_adapters` wraps a built engine
+  (bf16 or quantized base) with per-lane batched-gather LoRA epilogues
+  riding the ragged metadata, backed by a paged `AdapterPool` —
+  hundreds of tenant adapters on ONE engine, zero steady-state
+  retraces across any adapter mix.
 """
 from .disagg import DisaggRouter, HandoffError, HandoffState
 from .engine import EngineCore, MLPLMEngine
@@ -50,22 +55,28 @@ from .fault_tolerance import (AdmissionConfig, EngineStalled,
                               EngineStepError, WatchdogConfig)
 from .fleet import FleetHandle, FleetRouter, ReplicaHandle
 from .frontend import RequestHandle, ServingFrontend
+from .lora import (AdapterError, AdapterPool, AdapterPoolExhausted,
+                   AdapterRankError, LoRAEngine, UnknownAdapterError,
+                   attach_adapters)
 from .metrics import ServingMetrics
 from .quant import greedy_agreement, quant_summary, quantize_engine
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
-from .slo import SLOClass, SLOConfig
+from .slo import SLOClass, SLOConfig, slo_for_adapters
 from .spec import (DraftEngineProposer, NGramProposer, Proposer,
                    SpecDecodeConfig)
 from .tp import ShardedEngine, ShardingConfigError, shard_engine
 
 __all__ = [
-    "AdmissionConfig", "DisaggRouter", "DraftEngineProposer", "EngineCore",
+    "AdapterError", "AdapterPool", "AdapterPoolExhausted",
+    "AdapterRankError", "AdmissionConfig", "DisaggRouter",
+    "DraftEngineProposer", "EngineCore",
     "EngineStalled", "EngineStepError", "FleetHandle", "FleetRouter",
-    "HandoffError", "HandoffState", "MLPLMEngine",
+    "HandoffError", "HandoffState", "LoRAEngine", "MLPLMEngine",
     "NGramProposer", "Proposer", "ReplicaHandle", "Request",
     "RequestHandle", "RequestStatus", "SamplingParams", "Scheduler",
     "ServingFrontend", "ServingMetrics", "ShardedEngine",
     "ShardingConfigError", "SLOClass", "SLOConfig", "SpecDecodeConfig",
-    "WatchdogConfig", "greedy_agreement", "quant_summary",
-    "quantize_engine", "shard_engine",
+    "UnknownAdapterError", "WatchdogConfig", "attach_adapters",
+    "greedy_agreement", "quant_summary",
+    "quantize_engine", "shard_engine", "slo_for_adapters",
 ]

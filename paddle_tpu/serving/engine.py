@@ -260,13 +260,18 @@ def _mlp_verify_q(params, cache, cache_scale, tokens, ctx_lens, tables, *,
 
 
 def _mlp_mm(h, w):
-    """h [..., K] @ head weight: dense [K, N] array, or weight-only-
+    """h [..., K] @ head weight: dense [K, N] array, weight-only-
     quantized {"q": [N, K], "s": [N]} / int4 {"q4": [N, K//2], "s"}
     through the shared `nn.quant.dequant_matmul` (the same dict layout
     the Llama engine's `_mm` consumes — `serving/quant.py` produces
-    both)."""
+    both), or a multi-LoRA epilogue dict {"w", "la", "lb", "ids"} that
+    recursively wraps either (`serving/lora.py`)."""
     if not isinstance(w, dict):
         return h @ w
+    if "la" in w:
+        from .lora import lora_mm
+
+        return lora_mm(h, w, _mlp_mm)
     from ..nn.quant import dequant_matmul
 
     if "q4" in w:

@@ -135,6 +135,7 @@ class ReplicaHandle:
         already is."""
         s = self.frontend.scheduler
         pstats = s.prefix_stats()
+        pool = getattr(s.engine, "adapter_pool", None)
         return {
             "queue_depth": len(s.waiting),
             "running": s.num_running,
@@ -143,6 +144,10 @@ class ReplicaHandle:
             "tokens_generated": self.tokens_produced,
             "prefix_hit_rate": (pstats["hit_rate"] if pstats else 0.0),
             "prefix_cached_blocks": (pstats["nodes"] if pstats else 0),
+            # multi-LoRA (serving/lora.py): which adapters are HOT here
+            # — the router's adapter-affinity evidence (a request landing
+            # where its adapter is resident admits without a pool load)
+            "resident_adapters": (pool.resident_names() if pool else []),
         }
 
     def __repr__(self):
@@ -403,14 +408,19 @@ class FleetRouter:
 
     def _targets(self, session_id: Optional[str],
                  exclude: Set[ReplicaHandle],
-                 phase: Optional[str] = None) -> List[ReplicaHandle]:
+                 phase: Optional[str] = None,
+                 adapter: Optional[str] = None) -> List[ReplicaHandle]:
         """Ordered placement candidates. `phase` names the work being
         placed — "prefill" (a fresh/folded prompt) prefers
         prefill-capable replicas, "decode" (a migrated-KV session)
         prefers decode-capable ones; mixed replicas serve both. The
         role filter is a preference, not a fence: when the wanted tier
         has no placeable replica (all dead/draining), the whole fleet
-        is eligible — availability beats specialization."""
+        is eligible — availability beats specialization. `adapter`
+        front-moves replicas whose adapter pool already holds the
+        request's LoRA adapter (resident = admission without a priced
+        pool load — the same advisory affinity as sessions; session
+        affinity, applied after, still wins)."""
         placeable = [r for r in self._replicas
                      if r.alive and not r.draining and r not in exclude]
         if phase is not None:
@@ -420,6 +430,15 @@ class FleetRouter:
                 placeable = tiered
         placeable.sort(key=lambda r: (self._score(r),
                                       self._replicas.index(r)))
+        if adapter is not None:
+            def _hot(rep):
+                pool = getattr(rep.frontend.scheduler.engine,
+                               "adapter_pool", None)
+                try:
+                    return pool is not None and pool.is_resident(adapter)
+                except Exception:
+                    return False
+            placeable.sort(key=lambda r: 0 if _hot(r) else 1)
         if session_id is not None:
             home = self._rep(self._sessions.get(session_id, ""))
             if home is not None and home in placeable:
@@ -434,7 +453,8 @@ class FleetRouter:
                timeout_s: Optional[float] = None,
                stream_cb=None, seed: int = 0,
                session_id: Optional[str] = None,
-               tenant: Optional[str] = None) -> FleetHandle:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> FleetHandle:
         """`ServingFrontend.submit` fleet-wide: place on the session's
         home replica (when `session_id` is given and its replica lives)
         or the least-loaded replica; a shed/queue-full answer retries on
@@ -453,9 +473,19 @@ class FleetRouter:
         cb = None
         if stream_cb is not None:
             cb = lambda req, tok, _cb=stream_cb: _cb(tok)  # noqa: E731
+        if adapter is not None and tenant is None:
+            # tenant = adapter when any replica's SLO config carries a
+            # class by that name (the frontend.submit mapping, fleet-wide
+            # — configs are deployed uniformly, so first-live suffices)
+            for rep in self.live_replicas:
+                slo = rep.frontend.scheduler._slo
+                if slo is not None and adapter in slo.classes:
+                    tenant = adapter
+                    break
         req = Request(prompt_ids, sampling=sp,
                       deadline=None if timeout_s is None
-                      else now + timeout_s, stream_cb=cb, tenant=tenant)
+                      else now + timeout_s, stream_cb=cb, tenant=tenant,
+                      adapter=adapter)
         req.session_id = session_id
         fh = FleetHandle(req, max_new_tokens, session_id)
         _monitor.inc("fleet.submitted")
@@ -480,7 +510,8 @@ class FleetRouter:
         `no_replica_available`)."""
         req = fh._req
         attempts_left = self.submit_retries + 1
-        for rep in self._targets(fh.session_id, exclude, phase="prefill"):
+        for rep in self._targets(fh.session_id, exclude, phase="prefill",
+                                 adapter=req.adapter):
             if attempts_left <= 0:
                 break
             try:
@@ -521,7 +552,8 @@ class FleetRouter:
         reason)."""
         req = fh._req
         attempts_left = self.submit_retries + 1
-        for rep in self._targets(fh.session_id, exclude, phase="decode"):
+        for rep in self._targets(fh.session_id, exclude, phase="decode",
+                                 adapter=req.adapter):
             if attempts_left <= 0:
                 break
             try:

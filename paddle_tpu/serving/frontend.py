@@ -27,6 +27,7 @@ from .fault_tolerance import (AdmissionConfig, EngineStalled,
                               WatchdogConfig)
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, SamplingParams, Scheduler
+from .slo import DEFAULT_TENANT
 
 __all__ = ["RequestHandle", "ServingFrontend"]
 
@@ -161,13 +162,19 @@ class ServingFrontend:
                eos_token_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
                stream_cb=None, seed: int = 0,
-               tenant: Optional[str] = None) -> RequestHandle:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> RequestHandle:
         """Enqueue a generation request. NEVER raises on load conditions:
         a request that cannot be served comes back already-terminal with
-        `finish_reason` in {prompt_too_long, queue_full, empty_prompt}
-        (REJECTED) or a watermark/deadline reason (SHED). `tenant` names
-        the request's SLO class when an `SLOConfig` is installed
-        (unknown/None -> the default class)."""
+        `finish_reason` in {prompt_too_long, queue_full, empty_prompt,
+        unknown_adapter, no_adapter_pool} (REJECTED) or a
+        watermark/deadline reason (SHED). `tenant` names the request's
+        SLO class when an `SLOConfig` is installed (unknown/None -> the
+        default class). `adapter` names a registered LoRA adapter on a
+        multi-LoRA engine (`serving/lora.py`); when the installed SLO
+        config carries a class per adapter (`slo_for_adapters`) and no
+        explicit tenant was given, the adapter IS the tenant — quota,
+        reserve, and fair-share compose per adapter for free."""
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
         now = self._clock()
         deadline = None if timeout_s is None else now + timeout_s
@@ -177,8 +184,12 @@ class ServingFrontend:
         cb = None
         if stream_cb is not None:
             cb = lambda req, tok, _cb=stream_cb: _cb(tok)  # noqa: E731
+        if adapter is not None and (tenant is None or tenant == DEFAULT_TENANT):
+            slo = self.scheduler._slo
+            if slo is not None and adapter in slo.classes:
+                tenant = adapter
         req = Request(prompt_ids, sampling=sp, deadline=deadline,
-                      stream_cb=cb, tenant=tenant)
+                      stream_cb=cb, tenant=tenant, adapter=adapter)
         self.scheduler.submit(req, now=now)
         return RequestHandle(req)
 

@@ -157,6 +157,12 @@ class ServingMetrics:
             else:
                 self.ttft_cold_s.append(t)
                 monitor.observe("serving.ttft_cold_seconds", t)
+            if getattr(req, "adapter", None):
+                # per-adapter TTFT attribution: an adapter whose
+                # requests keep missing the pool (priced admission)
+                # shows up as a fat histogram right here
+                monitor.observe(f"serving.lora.ttft_seconds.{req.adapter}",
+                                t)
 
     # ---- shared-prefix radix cache ----
     def on_prefix_lease(self, hit_tokens: int):
@@ -199,6 +205,24 @@ class ServingMetrics:
         if bpt is not None:
             monitor.set_gauge("serving.kv_bytes_per_token",
                               round(float(bpt), 1))
+
+    # ---- multi-LoRA serving ----
+    def on_lora(self, info: dict):
+        """Publish the adapter pool's shape (serving/lora.py
+        `lora_info`): slot count, residency, registry size, padded rank
+        — `serving.lora.{pool_slots,resident_adapters,
+        registered_adapters,rank_max}`. Bind-time like `on_quant`; the
+        churn counters (`serving.lora.{miss_loads,evictions,
+        switch_retraces}`) are bumped at their source in the pool and
+        the wrapper traces."""
+        monitor.set_gauge("serving.lora.pool_slots",
+                          int(info.get("pool_slots", 0)))
+        monitor.set_gauge("serving.lora.resident_adapters",
+                          int(info.get("resident_adapters", 0)))
+        monitor.set_gauge("serving.lora.registered_adapters",
+                          int(info.get("registered", 0)))
+        monitor.set_gauge("serving.lora.rank_max",
+                          int(info.get("rank_max", 0)))
 
     # ---- multi-tenant SLO classes ----
     def on_tenant_admit(self, tenant: str):

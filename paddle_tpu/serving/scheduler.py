@@ -88,6 +88,7 @@ from ..inference.prefix_cache import RadixPrefixCache
 from ..ops.sampling import sample_tokens
 from ..resilience import faults as _faults
 from .engine import EngineCore
+from .lora import AdapterPoolExhausted
 from .fault_tolerance import (AdmissionConfig, EngineStepError,
                               OverloadController, WatchdogConfig)
 from .metrics import ServingMetrics
@@ -138,7 +139,8 @@ class Request:
     def __init__(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                  deadline: Optional[float] = None,
                  stream_cb: Optional[Callable[["Request", int], None]] = None,
-                 tenant: str = DEFAULT_TENANT):
+                 tenant: str = DEFAULT_TENANT,
+                 adapter: Optional[str] = None):
         self.req_id = next(Request._ids)
         self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self.sampling = sampling or SamplingParams()
@@ -147,6 +149,14 @@ class Request:
         # multi-tenant SLO class (serving/slo.py): quota, lane weight,
         # and watermark tier all key off this; "default" = untiered
         self.tenant = tenant or DEFAULT_TENANT
+        # multi-LoRA serving (serving/lora.py): which registered adapter
+        # decorates this request's lanes; None = the base model.
+        # `_adapter_slot` != None ⟺ this request holds one pool lease in
+        # the CURRENT engine's adapter pool (taken at admission, dropped
+        # at every slot/queue exit — and zeroed without release when a
+        # watchdog swap discards the pool with the engine)
+        self.adapter = adapter
+        self._adapter_slot: Optional[int] = None
         self.generated: List[int] = []
         self.status = RequestStatus.QUEUED
         self.finish_reason: Optional[str] = None
@@ -314,6 +324,11 @@ class Scheduler:
         self._overload_by_tenant = {}
         self._vtime = {}
         self._vclock = 0.0
+        # multi-LoRA admission pricing (serving/lora.py): how many
+        # adapter-MISS admissions (pool upload + possible eviction) one
+        # admission round may pay for; resident-adapter admissions are
+        # free and never count against it
+        self.adapter_miss_loads_per_step = 1
         self._bind_manager(engine.manager)
 
     def _bind_manager(self, mgr):
@@ -362,6 +377,26 @@ class Scheduler:
                 # bind must survive a broken hook, but not silently:
                 # unset quant gauges + this counter point at the cause
                 _monitor.inc("serving.quant_info_errors")
+        # multi-LoRA engine surface (serving/lora.py), re-resolved after
+        # every engine swap: the adapter pool leases at admission, and
+        # the per-lane slot vector is pushed before each dispatch
+        self._lora = getattr(self.engine, "adapter_pool", None)
+        self._set_lanes = getattr(self.engine, "set_lane_adapters", None)
+        self._lora_zero = int(getattr(self.engine, "zero_slot", 0))
+        # a swap killed the old pool's device state with the old engine:
+        # any queued request still pointing at an old slot re-leases
+        # against the fresh pool at its next admission
+        for req in self.waiting:
+            req._adapter_slot = None
+        for req in self.slots:
+            if req is not None:
+                req._adapter_slot = None
+        linfo = getattr(self.engine, "lora_info", None)
+        if linfo is not None:
+            try:
+                self.metrics.on_lora(linfo())
+            except Exception:
+                _monitor.inc("serving.lora_info_errors")
 
     # ---- waiting-queue bookkeeping (cost-accounted) ----
     def _queue_push(self, req: Request, front: bool = False):
@@ -403,6 +438,13 @@ class Scheduler:
         # +1: the sequence must be able to hold at least one generated token
         if mgr.blocks_needed(len(req.prompt) + 1) > self._usable_blocks:
             return self._reject(req, "prompt_too_long")
+        if req.adapter is not None:
+            # typed submit-time rejection beats an admission-time fault:
+            # an unknown adapter can never become leasable by waiting
+            if self._lora is None:
+                return self._reject(req, "no_adapter_pool")
+            if not self._lora.is_registered(req.adapter):
+                return self._reject(req, "unknown_adapter")
         if self._overload is not None:
             ctrl = self._overload_for(req.tenant)
             cfg = ctrl.cfg
@@ -657,6 +699,7 @@ class Scheduler:
         if req in self.waiting:
             self._queue_remove(req)
             self._drop_resident_kv(req)
+            self._adapter_release(req)
             req.status = RequestStatus.PREEMPTED
             return True
         for i, r in enumerate(self.slots):
@@ -665,6 +708,7 @@ class Scheduler:
                 self._publish_prefix(req)
                 self.engine.manager.free(req.seq_id)
                 self._release_spec(req)
+                self._adapter_release(req)
                 req.status = RequestStatus.PREEMPTED
                 return True
         return False
@@ -852,7 +896,11 @@ class Scheduler:
     def _obs_req(self, req: Request, name: str, t0: Optional[float] = None,
                  t1: Optional[float] = None, **meta):
         """Request-track timeline event; call sites guard on
-        `_obs.enabled()` so the disabled path allocates nothing."""
+        `_obs.enabled()` so the disabled path allocates nothing. A
+        LoRA request's adapter rides every event — the timeline answers
+        "whose TTFT paid an adapter load" without a metrics join."""
+        if req.adapter is not None and "adapter" not in meta:
+            meta["adapter"] = req.adapter
         _obs.timeline.request_event(
             req.req_id, name, self._clock() if t0 is None else t0, t1,
             **meta)
@@ -995,6 +1043,11 @@ class Scheduler:
             except KeyError:
                 pass
             self._release_spec(req)
+            # NOT _adapter_release: the old pool's device state (and its
+            # lease books) die with the old engine — releasing a stale
+            # slot against the FRESH pool would corrupt its refcounts.
+            # `_bind_manager` below clears every queued slot the same way.
+            req._adapter_slot = None
             req.status = RequestStatus.PREEMPTED
             req.num_preemptions += 1
             self._queue_push(req, front=True)
@@ -1116,6 +1169,10 @@ class Scheduler:
         mgr = self.engine.manager
         admitted = 0
         skip: set = set()               # tenants deferred this round
+        # adapter-miss admissions are PRICED: each pays a pool upload
+        # (possibly an eviction first), so only this many may enter per
+        # round — resident-adapter requests stay free and unbudgeted
+        miss_budget = self.adapter_miss_loads_per_step
         while self.waiting and None in self.slots:
             req = self._next_admit(mgr, skip)
             if req is None:
@@ -1158,6 +1215,36 @@ class Scheduler:
                     self.metrics.on_tenant_deferred(req.tenant,
                                                     "kv_reserve")
                     continue
+            if req.adapter is not None and self._lora is not None:
+                # adapter lease precedes the KV lease: residency is the
+                # cheap common case (refcount bump), a miss spends the
+                # round's priced load budget, and a full pool defers —
+                # without an SLO config the queue is strict FIFO, so a
+                # deferral must stop the round (skip is FIFO-invisible)
+                resident_ad = self._lora.is_resident(req.adapter)
+                if not resident_ad and miss_budget <= 0:
+                    if self._slo is None:
+                        break
+                    skip.add(req.tenant)
+                    self.metrics.on_tenant_deferred(req.tenant,
+                                                    "adapter_miss")
+                    continue
+                try:
+                    req._adapter_slot = self._lora.lease(req.adapter)
+                except AdapterPoolExhausted:
+                    if self._slo is None:
+                        break          # leases return as runners finish
+                    skip.add(req.tenant)
+                    self.metrics.on_tenant_deferred(req.tenant,
+                                                    "adapter_pool")
+                    continue
+                except Exception:      # injected/failed adapter load
+                    self._queue_remove(req)
+                    self._isolated(req, "engine_fault:adapter",
+                                   "adapter", in_slot=False)
+                    continue
+                if not resident_ad:
+                    miss_budget -= 1
             hit = 0
             if resident:
                 # the migrated KV covers the committed context; the
@@ -1193,6 +1280,9 @@ class Scheduler:
                     if hit == 0:
                         mgr.allocate(req.seq_id, 0)
                 except (KVCacheExhausted, SequenceTooLong):
+                    # the adapter lease taken above must not outlive
+                    # this failed admission attempt
+                    self._adapter_release(req)
                     break
                 except Exception:      # injected/corrupt cache state
                     self._queue_remove(req)
@@ -1317,6 +1407,7 @@ class Scheduler:
         self._publish_prefix(req)
         self.engine.manager.free(req.seq_id)
         self._release_spec(req)
+        self._adapter_release(req)
         self.slots[slot] = None
         req.status = RequestStatus.PREEMPTED
         req.num_preemptions += 1
@@ -1447,6 +1538,7 @@ class Scheduler:
             for i, r in survivors:
                 mgr.trim(r.seq_id, pre_lens[r.seq_id])
 
+        self._install_lane_adapters()
         try:
             with RecordEvent("serving.decode_step"):
                 logits, flagged = self._dispatch(
@@ -1667,6 +1759,7 @@ class Scheduler:
                 mgr.trim(r.seq_id, pre_lens[r.seq_id])
 
         lane_pairs = [(i, r) for i, r, _t, _p, _f in lanes]
+        self._install_lane_adapters()
         try:
             with RecordEvent("serving.verify_step"):
                 logits, flagged = self._dispatch(
@@ -1815,6 +1908,7 @@ class Scheduler:
             # that no slot path will ever free
             self._drop_resident_kv(req)
         self._release_spec(req)
+        self._adapter_release(req)
         req.status = status
         req.finish_reason = reason
         req.t_finish = self._clock()
@@ -1837,3 +1931,30 @@ class Scheduler:
             self.spec.proposer.release(req.seq_id)
         except Exception:
             pass
+
+    def _adapter_release(self, req: Request):
+        """Drop a request's adapter-pool lease on any exit from the
+        batch or queue (finish, cancel, preempt, drain, failed
+        admission). Idempotent — `_adapter_slot` is the lease token, and
+        clearing it first makes a re-entrant release a no-op; never
+        raises into the serving path."""
+        if req._adapter_slot is None or self._lora is None:
+            return
+        req._adapter_slot = None
+        try:
+            self._lora.release(req.adapter)
+        except Exception:
+            _monitor.inc("serving.lora.release_errors")
+
+    def _install_lane_adapters(self):
+        """Push the per-lane adapter-slot vector for this round's
+        dispatch: occupied lanes carry their request's leased slot,
+        empty/base lanes the reserved zero slot. Pure data on a fixed
+        [B] shape — adapter churn between rounds can never retrace."""
+        if self._set_lanes is None:
+            return
+        lanes = np.full((len(self.slots),), self._lora_zero, np.int32)
+        for i, r in enumerate(self.slots):
+            if r is not None and r._adapter_slot is not None:
+                lanes[i] = r._adapter_slot
+        self._set_lanes(lanes)

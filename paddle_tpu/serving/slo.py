@@ -35,7 +35,7 @@ from typing import Dict, Iterable, Optional
 
 from .fault_tolerance import AdmissionConfig
 
-__all__ = ["SLOClass", "SLOConfig"]
+__all__ = ["SLOClass", "SLOConfig", "slo_for_adapters"]
 
 DEFAULT_TENANT = "default"
 
@@ -120,3 +120,24 @@ class SLOConfig:
                 continue
             total += max(0, c.kv_reserve_blocks - held.get(name, 0))
         return total
+
+
+def slo_for_adapters(adapters: Iterable[str], *, weight: float = 1.0,
+                     kv_quota_blocks: Optional[int] = None,
+                     kv_reserve_blocks: int = 0,
+                     admission_scale: float = 1.0,
+                     extra: Iterable[SLOClass] = ()) -> SLOConfig:
+    """Tenant = adapter composition for multi-LoRA serving
+    (`serving/lora.py`): one SLO class PER registered adapter name, all
+    with the same policy knobs, plus any `extra` hand-tuned classes
+    (which win on a name collision). The frontend maps a request's
+    `adapter=` to its tenant when the installed config carries that
+    class — so per-adapter KV quotas, reserves, and deficit-weighted
+    fair lanes compose with zero extra plumbing."""
+    extra = list(extra)
+    named = {c.name for c in extra}
+    classes = [SLOClass(a, weight=weight, kv_quota_blocks=kv_quota_blocks,
+                        kv_reserve_blocks=kv_reserve_blocks,
+                        admission_scale=admission_scale)
+               for a in adapters if a not in named]
+    return SLOConfig(classes + extra)

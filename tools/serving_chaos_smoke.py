@@ -24,6 +24,14 @@ counted over unique physical blocks incl. the tree's leases, refcount
 consistency (no shared block double-freed), survivor parity vs the
 unfaulted cached run.
 
+Adapter-pool pass (`serve.adapter`, ISSUE 18): the fault fires during a
+multi-LoRA adapter LOAD (a lease miss mid-batch, with the pool smaller
+than the working set so evictions are in flight). The faulted admission
+fails typed `engine_fault:adapter`; every other adapter's request rides
+through with survivor parity, and afterwards the pool's refcount books
+audit clean (`AdapterPool.check_consistency()`, zero outstanding
+leases) alongside the usual zero-leaked-KV contract.
+
 Fleet pass (`fleet.step`): the same contract FLEET-WIDE — a replica is
 killed mid-Poisson-burst (the armed `fleet.step` flag fires the chaos
 kill on the busiest replica), and afterwards: every request terminal,
@@ -221,6 +229,80 @@ def quant_chaos():
     assert monitor.get("serving.quant.wbits") == 8
     report["kv_bits"] = frag["kv_bits"]
     report["bytes_per_block"] = frag["bytes_per_block"]
+    return report
+
+
+def make_lora_engine():
+    """Multi-LoRA twin of `make_engine` (ISSUE 18): a paged adapter pool
+    DELIBERATELY smaller than the working set (3 slots, 6 adapters) so
+    the faulted run exercises the load/evict path mid-batch, not just
+    resident hits. Registration is seed-deterministic, so the watchdog's
+    rebuilt engine carries identical adapter weights."""
+    from paddle_tpu.serving import MLPLMEngine, attach_adapters
+    from paddle_tpu.serving.lora import random_adapter
+
+    eng = attach_adapters(
+        MLPLMEngine(vocab_size=VOCAB, hidden=16, max_batch_size=4,
+                    num_blocks=48, block_size=4, max_blocks_per_seq=8),
+        pool_slots=3, rank_buckets=(2, 4))
+    for i in range(6):
+        eng.adapter_pool.register(
+            f"ad{i}", random_adapter(eng, rank=2 + 2 * (i % 2), seed=i))
+    return eng
+
+
+def lora_run(arm=None):
+    from paddle_tpu.serving import (ServingFrontend, ServingMetrics,
+                                    WatchdogConfig)
+
+    ServingMetrics.reset_monitor()
+    fe = ServingFrontend(
+        make_lora_engine(),
+        watchdog=WatchdogConfig(step_retries=2, max_restarts=MAX_RESTARTS),
+        engine_factory=make_lora_engine, stall_after=256)
+    handles = [fe.submit(p, max_new_tokens=6, adapter=f"ad{i % 6}")
+               for i, p in enumerate(trace())]
+    if arm is not None:
+        arm(handles)
+    fe.run_until_idle(max_steps=4000)
+    return fe, handles
+
+
+def lora_chaos():
+    """Adapter-pool pass: the `serve.adapter` fault fires during an
+    adapter LOAD (a lease miss — upload/evict in flight) mid-batch. The
+    faulted admission must fail typed `engine_fault:adapter` while every
+    other request rides through; afterwards the pool's refcount books
+    must audit clean (zero leases, slot-map invertible, free list
+    disjoint) on top of the usual terminal/leak/parity contract."""
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import RequestStatus
+
+    faults.clear()
+    _, ref_h = lora_run()
+    assert all(h.status is RequestStatus.FINISHED for h in ref_h), \
+        "multi-LoRA fault-free reference did not finish"
+    assert monitor.get("serving.lora.evictions") > 0, \
+        "pool (3 slots) vs working set (6 adapters) produced no evictions"
+    reference = [h.tokens for h in ref_h]
+
+    faults.clear()
+    fe, hs = lora_run(
+        arm=lambda _h: faults.inject("serve.adapter", after_n=3, times=1))
+    faults.clear()
+    report = check_contract("serve.adapter:pool", fe, hs, reference,
+                            expect_failed=["engine_fault:adapter"])
+    pool = fe.scheduler.engine.adapter_pool
+    pool.check_consistency()
+    assert pool.leases() == 0, f"adapter leases leaked: {pool.leases()}"
+    stats = pool.stats()
+    report["adapter_pool"] = {"slots": stats["pool_slots"],
+                              "resident": stats["resident_adapters"],
+                              "evictions": monitor.get(
+                                  "serving.lora.evictions"),
+                              "miss_loads": monitor.get(
+                                  "serving.lora.miss_loads")}
     return report
 
 
@@ -618,6 +700,10 @@ def main():
     # planes (PR 14) — same zero-leak / terminal-status contract
     reports.append(quant_chaos())
 
+    # adapter-pool pass (ISSUE 18): serve.adapter fault during an
+    # adapter load/evict mid-batch — typed failure, clean refcount books
+    reports.append(lora_chaos())
+
     # fleet-wide pass: unkilled reference, then the mid-burst replica kill
     faults.clear()
     ref_router, ref_handles = fleet_run()
@@ -642,6 +728,8 @@ def main():
                     "prefix cache: shared-block fault -> no double-free, "
                     "int8 KV pool: cache fault -> zero leaks, quantized "
                     "byte geometry in telemetry, "
+                    "adapter pool: load fault -> typed failure, "
+                    "refcount books audit clean, "
                     "fleet: replica kill -> relocation parity, "
                     "relocations <= budget, survivors leak-free, "
                     "disagg: prefill kill mid-handoff -> zero lost, "
